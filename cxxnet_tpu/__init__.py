@@ -1,0 +1,36 @@
+"""cxxnet_tpu: a TPU-native, config-driven neural-network trainer.
+
+A ground-up JAX/XLA re-design of the capability surface of cxxnet (the
+pre-MXNet dmlc CNN trainer, surveyed in /root/repo/SURVEY.md): a single
+`key = value` config file declares data iterators, a layer DAG, updater
+settings and a task (train / pred / extract / finetune); the framework
+compiles the whole training step (forward + backward + gradient
+all-reduce + optimizer update) into one XLA program and runs it over a
+`jax.sharding.Mesh` of TPU chips.
+
+Architectural mapping from the reference (file:line cites refer to the
+reference tree, see SURVEY.md):
+
+- mshadow expression templates      -> jax.numpy / lax ops, XLA fusion
+- hand-written Backprop methods     -> jax.grad through the functional net
+- in-place node gradient storage    -> pure functional node values
+- NeuralNetThread-per-GPU + PS      -> single SPMD program over a Mesh,
+  (nnet/neural_net-inl.hpp:304)        gradients reduced by XLA AllReduce
+- mshadow-ps push/pull (updater.h)  -> compiler-inserted collectives over ICI
+- AdjustBatchSize dynamic batches   -> pad-to-static + masked loss/metrics
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["NetConfig", "NetTrainer", "create_net", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import cxxnet_tpu.utils` free of the jax import cost.
+    if name == "NetConfig":
+        from cxxnet_tpu.nnet.net_config import NetConfig
+        return NetConfig
+    if name in ("NetTrainer", "create_net"):
+        from cxxnet_tpu.nnet import trainer
+        return getattr(trainer, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

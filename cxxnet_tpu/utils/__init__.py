@@ -1,0 +1,12 @@
+"""Dependency-free utility layer: config parsing, metrics, binary page IO."""
+
+from cxxnet_tpu.utils.config import ConfigIterator, parse_config_string, parse_config_file
+from cxxnet_tpu.utils.metric import MetricSet, create_metric
+
+__all__ = [
+    "ConfigIterator",
+    "parse_config_string",
+    "parse_config_file",
+    "MetricSet",
+    "create_metric",
+]

@@ -1,0 +1,191 @@
+"""Evaluation metrics.
+
+Behavioral parity with the reference metrics (src/utils/metric.h:20-236),
+vectorized over the batch with numpy instead of per-instance loops:
+
+- ``error``:   argmax(pred) != label[0]; when pred has a single column the
+  decision is ``pred > 0`` (metric.h:91-110).
+- ``rmse``:    per-instance SUM of squared differences across the output
+  dimension, averaged over instances. NOTE: despite its name the reference
+  never takes a square root (metric.h:72-88 CalcMetric returns the squared
+  sum and Get() divides by instance count only) - we reproduce that exactly.
+- ``logloss``: -log(p[target]) clipped to [1e-15, 1-1e-15]; binary form for
+  single-column predictions (metric.h:113-132).
+- ``rec@n``:   fraction of the instance's labels found in the top-n
+  predictions (metric.h:135-177). The reference randomly shuffles before the
+  stable sort so ties are broken randomly; we add a tiny random jitter key
+  for the same effect.
+
+MetricSet mirrors src/utils/metric.h:175-236 + the trainer-side parsing of
+``metric = name`` and ``metric[label_name,node_name] = name``
+(nnet_impl-inl.hpp:57-67): each metric is bound to a label field name and
+Print renders ``\\t{evname}-{metric}[{field}]:{value}`` (field suffix omitted
+for the default "label" field).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class Metric:
+    """Accumulating metric over batches of (pred, label) numpy arrays."""
+
+    name: str
+
+    def __init__(self, name: str):
+        self.name = name
+        self.clear()
+
+    def clear(self) -> None:
+        self._sum = 0.0
+        self._cnt = 0
+
+    def add_eval(self, pred: np.ndarray, label: np.ndarray,
+                 mask: Optional[np.ndarray] = None) -> None:
+        """Accumulate over a batch.
+
+        pred: (n, k) prediction scores; label: (n, label_width);
+        mask: optional (n,) boolean selecting valid (non-padding) rows.
+        """
+        pred = np.asarray(pred)
+        label = np.asarray(label)
+        if pred.ndim == 1:
+            pred = pred[:, None]
+        if label.ndim == 1:
+            label = label[:, None]
+        if mask is not None:
+            mask = np.asarray(mask).astype(bool)
+            pred, label = pred[mask], label[mask]
+        if pred.shape[0] == 0:
+            return
+        vals = self._calc(pred.astype(np.float64), label.astype(np.float64))
+        self._sum += float(np.sum(vals))
+        self._cnt += int(pred.shape[0])
+
+    def get(self) -> float:
+        return self._sum / self._cnt if self._cnt else float("nan")
+
+    def _calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class MetricError(Metric):
+    def __init__(self) -> None:
+        super().__init__("error")
+
+    def _calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        if pred.shape[1] == 1:
+            maxidx = (pred[:, 0] > 0.0).astype(np.int64)
+        else:
+            maxidx = np.argmax(pred, axis=1)
+        return (maxidx != label[:, 0].astype(np.int64)).astype(np.float64)
+
+
+class MetricRMSE(Metric):
+    def __init__(self) -> None:
+        super().__init__("rmse")
+
+    def _calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        if pred.shape != label.shape:
+            raise ValueError(
+                "rmse metric requires pred and label of identical shape")
+        diff = pred - label
+        return np.sum(diff * diff, axis=1)
+
+
+class MetricLogloss(Metric):
+    def __init__(self) -> None:
+        super().__init__("logloss")
+
+    def _calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        eps = 1e-15
+        if pred.shape[1] == 1:
+            p = np.clip(pred[:, 0], eps, 1.0 - eps)
+            y = label[:, 0]
+            return -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+        target = label[:, 0].astype(np.int64)
+        p = np.clip(pred[np.arange(pred.shape[0]), target], eps, 1.0 - eps)
+        return -np.log(p)
+
+
+class MetricRecall(Metric):
+    """rec@n: fraction of labels recalled in the top-n predictions."""
+
+    def __init__(self, name: str):
+        if not name.startswith("rec@"):
+            raise ValueError("must specify n for rec@n")
+        self.topn = int(name[4:])
+        self._rng = np.random.RandomState(0)
+        super().__init__(name)
+
+    def _calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        n, k = pred.shape
+        if k < self.topn:
+            raise ValueError(
+                f"rec@{self.topn} meaningless for prediction list of size {k}")
+        # random tie-break (reference shuffles before sorting)
+        jitter = self._rng.uniform(0.0, 1.0, size=pred.shape)
+        order = np.lexsort((jitter, -pred), axis=1)
+        top = order[:, :self.topn]  # (n, topn) candidate indices
+        labels = label.astype(np.int64)  # (n, label_width)
+        hits = (top[:, :, None] == labels[:, None, :]).any(axis=1)
+        return hits.sum(axis=1) / labels.shape[1]
+
+
+def create_metric(name: str) -> Metric:
+    if name == "rmse":
+        return MetricRMSE()
+    if name == "error":
+        return MetricError()
+    if name == "logloss":
+        return MetricLogloss()
+    if name.startswith("rec@"):
+        return MetricRecall(name)
+    raise ValueError(f"Metric: unknown metric name: {name}")
+
+
+class MetricSet:
+    """A set of metrics, each bound to a label field name."""
+
+    def __init__(self) -> None:
+        self._metrics: List[Metric] = []
+        self._fields: List[str] = []
+
+    def add_metric(self, name: str, field: str = "label") -> None:
+        self._metrics.append(create_metric(name))
+        self._fields.append(field)
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    @property
+    def fields(self) -> List[str]:
+        return list(self._fields)
+
+    def clear(self) -> None:
+        for m in self._metrics:
+            m.clear()
+
+    def add_eval(self, preds: List[np.ndarray], labels: dict,
+                 mask: Optional[np.ndarray] = None) -> None:
+        """preds: one prediction array per metric; labels: field -> array."""
+        if len(preds) != len(self._metrics):
+            raise ValueError(
+                "Metric: number of prediction arrays must equal "
+                "number of metrics")
+        for m, field, pred in zip(self._metrics, self._fields, preds):
+            if field not in labels:
+                raise KeyError(f"Metric: unknown target = {field}")
+            m.add_eval(pred, labels[field], mask=mask)
+
+    def print(self, evname: str) -> str:
+        out = []
+        for m, field in zip(self._metrics, self._fields):
+            tag = f"{evname}-{m.name}"
+            if field != "label":
+                tag += f"[{field}]"
+            out.append(f"\t{tag}:{m.get():g}")
+        return "".join(out)

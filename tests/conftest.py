@@ -1,0 +1,15 @@
+"""Test configuration: run JAX on a virtual 8-device CPU platform.
+
+Multi-chip sharding is validated on a host-platform mesh (the analog of the
+reference's "local" parameter-server flavor standing in for the distributed
+one - SURVEY.md par.4). Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

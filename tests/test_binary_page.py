@@ -1,0 +1,55 @@
+"""Tests for the BinaryPage packed-blob format."""
+
+import io
+import struct
+
+from cxxnet_tpu.utils.binary_page import (
+    K_PAGE_SIZE, BinaryPage, BinaryPageWriter, iter_page_blobs)
+
+
+def test_push_get_roundtrip():
+    p = BinaryPage()
+    blobs = [b"hello", b"", b"x" * 1000, bytes(range(256))]
+    for b in blobs:
+        assert p.push(b)
+    assert len(p) == len(blobs)
+    for i, b in enumerate(blobs):
+        assert p[i] == b
+
+
+def test_page_full_behavior():
+    p = BinaryPage()
+    big = b"z" * (30 * 1024 * 1024)
+    assert p.push(big)
+    assert p.push(big)
+    assert not p.push(big)  # third 30MiB blob cannot fit in 64MiB
+    assert len(p) == 2
+
+
+def test_byte_layout_matches_reference():
+    """count at int[0], cumulative offsets from int[1], blobs from page end."""
+    p = BinaryPage()
+    p.push(b"abcd")
+    p.push(b"ef")
+    raw = bytes(p._buf)
+    assert struct.unpack_from("<i", raw, 0)[0] == 2
+    assert struct.unpack_from("<i", raw, 4)[0] == 0
+    assert struct.unpack_from("<i", raw, 8)[0] == 4
+    assert struct.unpack_from("<i", raw, 12)[0] == 6
+    assert raw[K_PAGE_SIZE - 4:K_PAGE_SIZE] == b"abcd"
+    assert raw[K_PAGE_SIZE - 6:K_PAGE_SIZE - 4] == b"ef"
+
+
+def test_writer_multi_page_roundtrip():
+    buf = io.BytesIO()
+    w = BinaryPageWriter(buf)
+    blobs = [bytes([i % 251]) * (7 * 1024 * 1024) for i in range(12)]
+    for b in blobs:
+        w.push(b)
+    w.close()
+    assert buf.tell() % K_PAGE_SIZE == 0
+    assert buf.tell() >= 2 * K_PAGE_SIZE  # spilled to more than one page
+
+    buf.seek(0)
+    out = [b for page in iter_page_blobs(buf) for b in page]
+    assert out == blobs

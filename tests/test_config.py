@@ -1,0 +1,71 @@
+"""Tests for the key=value config tokenizer."""
+
+import pytest
+
+from cxxnet_tpu.utils.config import (ConfigError, parse_config_string)
+
+
+def test_basic_pairs():
+    assert parse_config_string("a = 1\nb = 2\n") == [("a", "1"), ("b", "2")]
+
+
+def test_glued_equals():
+    assert parse_config_string("a=1") == [("a", "1")]
+    assert parse_config_string("a= 1") == [("a", "1")]
+    assert parse_config_string("a =1") == [("a", "1")]
+
+
+def test_comments():
+    text = "# leading comment\na = 1  # trailing\n# full line\nb = 2\n"
+    assert parse_config_string(text) == [("a", "1"), ("b", "2")]
+
+
+def test_quoted_values():
+    assert parse_config_string('path = "./data/my file.gz"') == [
+        ("path", "./data/my file.gz")]
+    # hash inside quotes is literal
+    assert parse_config_string('v = "a#b"') == [("v", "a#b")]
+    # backslash escapes
+    assert parse_config_string(r'v = "a\"b"') == [("v", 'a"b')]
+
+
+def test_single_quote_multiline():
+    assert parse_config_string("v = 'line1\nline2'") == [("v", "line1\nline2")]
+
+
+def test_unterminated_double_quote():
+    with pytest.raises(ConfigError):
+        parse_config_string('v = "abc\n')
+
+
+def test_bracket_keys():
+    # layer DAG keys pass through untouched
+    assert parse_config_string("layer[0->1] = conv:c1") == [
+        ("layer[0->1]", "conv:c1")]
+    assert parse_config_string("metric[label,fc2] = error") == [
+        ("metric[label,fc2]", "error")]
+    assert parse_config_string("wmat:lr = 0.01") == [("wmat:lr", "0.01")]
+
+
+def test_reference_mnist_conf_shape():
+    """The reference MNIST config style parses into ordered pairs."""
+    text = """
+data = train
+iter = mnist
+    path_img = "./data/train-images-idx3-ubyte.gz"
+    shuffle = 1
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+batch_size = 100
+eta = 0.1
+"""
+    pairs = parse_config_string(text)
+    assert pairs[0] == ("data", "train")
+    assert ("netconfig", "start") in pairs
+    assert ("layer[+1:fc1]", "fullc:fc1") in pairs
+    assert pairs[-1] == ("eta", "0.1")

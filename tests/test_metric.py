@@ -1,0 +1,92 @@
+"""Tests for metrics vs straightforward per-instance computation."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.utils.metric import MetricSet, create_metric
+
+
+def test_error_multiclass():
+    m = create_metric("error")
+    pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = np.array([[1], [1], [1]])
+    m.add_eval(pred, label)
+    assert m.get() == pytest.approx(1.0 / 3.0)
+
+
+def test_error_binary_single_column():
+    m = create_metric("error")
+    pred = np.array([[0.5], [-0.5], [2.0]])
+    label = np.array([[1], [0], [0]])
+    m.add_eval(pred, label)
+    assert m.get() == pytest.approx(1.0 / 3.0)
+
+
+def test_rmse_is_sum_of_squares_mean():
+    # reference quirk: no sqrt; per-instance sum of squared diffs
+    m = create_metric("rmse")
+    pred = np.array([[1.0, 2.0], [0.0, 0.0]])
+    label = np.array([[0.0, 0.0], [0.0, 3.0]])
+    m.add_eval(pred, label)
+    assert m.get() == pytest.approx(((1 + 4) + 9) / 2.0)
+
+
+def test_logloss_multiclass_and_binary():
+    m = create_metric("logloss")
+    pred = np.array([[0.7, 0.3]])
+    label = np.array([[0]])
+    m.add_eval(pred, label)
+    assert m.get() == pytest.approx(-np.log(0.7))
+
+    b = create_metric("logloss")
+    b.add_eval(np.array([[0.8]]), np.array([[1.0]]))
+    assert b.get() == pytest.approx(-np.log(0.8))
+
+
+def test_logloss_clipping():
+    m = create_metric("logloss")
+    m.add_eval(np.array([[1.0, 0.0]]), np.array([[1]]))
+    assert np.isfinite(m.get())
+
+
+def test_recall_at_n():
+    m = create_metric("rec@2")
+    pred = np.array([[0.1, 0.5, 0.4], [0.9, 0.05, 0.05]])
+    label = np.array([[1], [2]])
+    m.add_eval(pred, label)
+    # instance 0: top2 = {1, 2} contains 1 -> hit; instance 1: top2 = {0, ...} no 2
+    assert m.get() == pytest.approx(0.5)
+
+
+def test_recall_multilabel():
+    m = create_metric("rec@2")
+    pred = np.array([[0.5, 0.4, 0.1]])
+    label = np.array([[0, 2]])
+    m.add_eval(pred, label)
+    assert m.get() == pytest.approx(0.5)
+
+
+def test_mask_excludes_padding():
+    m = create_metric("error")
+    pred = np.array([[0.9, 0.1], [0.9, 0.1]])
+    label = np.array([[1], [1]])
+    m.add_eval(pred, label, mask=np.array([True, False]))
+    assert m.get() == pytest.approx(1.0)
+
+
+def test_metric_set_print_format():
+    s = MetricSet()
+    s.add_metric("error")
+    s.add_metric("error", field="aux")
+    preds = [np.array([[0.1, 0.9]]), np.array([[0.9, 0.1]])]
+    labels = {"label": np.array([[1]]), "aux": np.array([[1]])}
+    s.add_eval(preds, labels)
+    out = s.print("test")
+    assert out == "\ttest-error:0\ttest-error[aux]:1"
+
+
+def test_accumulation_across_batches():
+    m = create_metric("error")
+    m.add_eval(np.array([[0.9, 0.1]]), np.array([[0]]))
+    m.add_eval(np.array([[0.9, 0.1]]), np.array([[1]]))
+    assert m.get() == pytest.approx(0.5)
