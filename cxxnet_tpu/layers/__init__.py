@@ -1,0 +1,14 @@
+"""Layer system: all cxxnet layer types as pure JAX function bundles."""
+
+from cxxnet_tpu.layers.base import (
+    LAYER_REGISTRY, Layer, LayerParam, create_layer, is_mat,
+    known_layer_types, register_layer)
+# importing the modules populates the registry
+from cxxnet_tpu.layers import common as _common  # noqa: F401
+from cxxnet_tpu.layers import loss as _loss  # noqa: F401
+from cxxnet_tpu.layers.loss import LossLayer
+
+__all__ = [
+    "LAYER_REGISTRY", "Layer", "LayerParam", "LossLayer", "create_layer",
+    "is_mat", "known_layer_types", "register_layer",
+]

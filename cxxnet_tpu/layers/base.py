@@ -1,0 +1,196 @@
+"""Layer system core: LayerParam, the Layer protocol, and the type registry.
+
+Design mapping from the reference (SURVEY.md par.1 critical idea #1):
+a reference `ILayer` is a stateful object with Forward/Backprop and owned
+weights; here a Layer is a *pure function bundle*:
+
+    layer.infer_shapes(in_shapes)          shape inference (InitConnection)
+    layer.init_params(key, in_shapes)      weight init      (InitModel)
+    layer.apply(params, inputs, train, rng) forward          (Forward)
+
+Backprop does not exist: the trainer differentiates through apply. The
+Node/Connection split survives at the net level: a layer holds no per-node
+state, so one layer's params can serve several connections (weight sharing,
+kSharedLayer - layer.h:283-284).
+
+Shapes are full NCHW tuples (batch, channel, y, x); "matrix" nodes are
+(batch, 1, 1, n) exactly like the reference Node convention (layer.h:33-54).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Shape = Tuple[int, int, int, int]
+Params = Dict[str, jax.Array]
+
+
+def is_mat(shape: Sequence[int]) -> bool:
+    """A node is a matrix when channel and y dims are 1 (layer.h:48-54)."""
+    return shape[1] == 1 and shape[2] == 1
+
+
+class LayerParam:
+    """Common layer hyperparameters (src/layer/param.h:15-111)."""
+
+    def __init__(self) -> None:
+        self.init_sigma = 0.01
+        self.init_uniform = -1.0
+        self.init_sparse = 10
+        self.init_bias = 0.0
+        self.random_type = 0  # 0 gaussian, 1 uniform/xavier, 2 kaiming
+        self.num_hidden = 0
+        self.num_channel = 0
+        self.num_group = 1
+        self.kernel_width = 0
+        self.kernel_height = 0
+        self.stride = 1
+        self.pad_x = 0
+        self.pad_y = 0
+        self.no_bias = 0
+        self.silent = 0
+        self.num_input_channel = 0
+        self.num_input_node = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "init_sigma":
+            self.init_sigma = float(val)
+        if name == "init_uniform":
+            self.init_uniform = float(val)
+        if name == "init_bias":
+            self.init_bias = float(val)
+        if name == "init_sparse":
+            self.init_sparse = int(val)
+        if name == "random_type":
+            if val == "gaussian":
+                self.random_type = 0
+            elif val in ("uniform", "xavier"):
+                self.random_type = 1
+            elif val == "kaiming":
+                self.random_type = 2
+            else:
+                raise ValueError(f"invalid random_type {val}")
+        if name == "nhidden":
+            self.num_hidden = int(val)
+        if name == "nchannel":
+            self.num_channel = int(val)
+        if name == "ngroup":
+            self.num_group = int(val)
+        if name == "kernel_size":
+            self.kernel_width = self.kernel_height = int(val)
+        if name == "kernel_height":
+            self.kernel_height = int(val)
+        if name == "kernel_width":
+            self.kernel_width = int(val)
+        if name == "stride":
+            self.stride = int(val)
+        if name == "pad":
+            self.pad_y = self.pad_x = int(val)
+        if name == "pad_y":
+            self.pad_y = int(val)
+        if name == "pad_x":
+            self.pad_x = int(val)
+        if name == "no_bias":
+            self.no_bias = int(val)
+        if name == "silent":
+            self.silent = int(val)
+
+    def rand_init_weight(self, key: jax.Array, shape: Sequence[int],
+                         in_num: int, out_num: int) -> jax.Array:
+        """Weight init parity with RandInitWeight (param.h:113-138)."""
+        shape = tuple(shape)
+        if self.random_type == 0:
+            return self.init_sigma * jax.random.normal(key, shape,
+                                                       dtype=jnp.float32)
+        if self.random_type == 1:
+            a = math.sqrt(3.0 / (in_num + out_num))
+            if self.init_uniform > 0:
+                a = self.init_uniform
+            return jax.random.uniform(key, shape, minval=-a, maxval=a,
+                                      dtype=jnp.float32)
+        if self.random_type == 2:
+            if self.num_hidden > 0:
+                sigma = math.sqrt(2.0 / self.num_hidden)
+            else:
+                sigma = math.sqrt(
+                    2.0 / (self.num_channel * self.kernel_width
+                           * self.kernel_height))
+            return sigma * jax.random.normal(key, shape, dtype=jnp.float32)
+        raise ValueError(f"invalid random_type {self.random_type}")
+
+
+class Layer:
+    """Base layer: stateless transform with optional trainable params."""
+
+    type_name: str = ""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.param = LayerParam()
+
+    # --- configuration ---------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        self.param.set_param(name, val)
+
+    # --- structure -------------------------------------------------------
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        raise NotImplementedError
+
+    def init_params(self, key: jax.Array,
+                    in_shapes: List[Shape]) -> Params:
+        """Return the layer's trainable params ({} when it has none)."""
+        return {}
+
+    def param_tags(self) -> Dict[str, str]:
+        """Updater scoping tag per param, mirroring ApplyVisitor names
+        (e.g. fullc: wmat->'wmat', bias->'bias'; prelu slope->'bias')."""
+        return {}
+
+    # --- compute ---------------------------------------------------------
+    def apply(self, params: Params, inputs: List[jax.Array], *,
+              train: bool, rng: Optional[jax.Array] = None,
+              ) -> List[jax.Array]:
+        raise NotImplementedError
+
+    # --- checkpoint helpers ----------------------------------------------
+    def check_one_to_one(self, in_shapes: List[Shape]) -> None:
+        if len(in_shapes) != 1:
+            raise ValueError(
+                f"{self.type_name}: layer only supports 1-1 connection")
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+LAYER_REGISTRY: Dict[str, Type[Layer]] = {}
+
+# layer types that are self-loops converting activations to gradients
+LOSS_TYPES = ("softmax", "l2_loss", "multi_logistic")
+
+
+def register_layer(cls: Type[Layer]) -> Type[Layer]:
+    assert cls.type_name, "layer class must define type_name"
+    LAYER_REGISTRY[cls.type_name] = cls
+    return cls
+
+
+def create_layer(type_name: str, name: str = "") -> Layer:
+    """Factory: config layer type string -> Layer instance.
+
+    Mirrors GetLayerType (layer.h:322-361) + CreateLayer_
+    (layer_impl-inl.hpp:36-76). `share[...]` and `pairtest-...` are handled
+    by the net config / pairtest harness, not here.
+    """
+    if type_name not in LAYER_REGISTRY:
+        raise ValueError(f'unknown layer type: "{type_name}"')
+    return LAYER_REGISTRY[type_name](name)
+
+
+def known_layer_types() -> List[str]:
+    return sorted(LAYER_REGISTRY)

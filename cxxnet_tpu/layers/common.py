@@ -1,0 +1,672 @@
+"""All non-loss layer implementations.
+
+Each class documents the reference file it mirrors behaviorally. Backward
+passes are autodiff; where the reference computes activation grads from the
+*output* values (op.h sigmoid_grad etc.) the analytic result is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu import ops
+from cxxnet_tpu.layers.base import (
+    Layer, Params, Shape, is_mat, register_layer)
+
+
+# ---------------------------------------------------------------------------
+# fully connected
+# ---------------------------------------------------------------------------
+
+@register_layer
+class FullConnectLayer(Layer):
+    """fullc (src/layer/fullc_layer-inl.hpp:14-146).
+
+    out = in . W^T + bias; W shape (nhidden, num_input_node).
+    """
+
+    type_name = "fullc"
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        (b, c, h, w) = in_shapes[0]
+        if not is_mat(in_shapes[0]):
+            raise ValueError("FullcLayer: input needs to be a matrix")
+        if self.param.num_hidden <= 0:
+            raise ValueError("FullcLayer: must set nhidden correctly")
+        self.param.num_input_node = w
+        return [(b, 1, 1, self.param.num_hidden)]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        nin = in_shapes[0][3]
+        nhidden = self.param.num_hidden
+        wmat = self.param.rand_init_weight(
+            key, (nhidden, nin), in_num=nin, out_num=nhidden)
+        params = {"wmat": wmat}
+        if self.param.no_bias == 0:
+            params["bias"] = jnp.full((nhidden,), self.param.init_bias,
+                                      dtype=jnp.float32)
+        return params
+
+    def param_tags(self) -> Dict[str, str]:
+        return {"wmat": "wmat", "bias": "bias"}
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        b = x.shape[0]
+        m = x.reshape(b, -1)
+        out = m @ params["wmat"].T
+        if "bias" in params:
+            out = out + params["bias"][None, :]
+        return [out.reshape(b, 1, 1, -1)]
+
+
+@register_layer
+class FixConnectLayer(Layer):
+    """fixconn (src/layer/fixconn_layer-inl.hpp:14-100): fully-connected
+    with frozen weights loaded from a sparse text file; no gradients."""
+
+    type_name = "fixconn"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.fname_weight = "NULL"
+        self._wmat: Optional[np.ndarray] = None
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "fixconn_weight":
+            self.fname_weight = val
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        if not is_mat(in_shapes[0]):
+            raise ValueError("FixConnLayer: input needs to be a matrix")
+        if self.param.num_hidden <= 0:
+            raise ValueError("FixConnLayer: must set nhidden correctly")
+        if self.fname_weight == "NULL":
+            raise ValueError("FixConnLayer: must specify fixconn_weight")
+        nin = in_shapes[0][3]
+        w = np.zeros((self.param.num_hidden, nin), dtype=np.float32)
+        with open(self.fname_weight, "r", encoding="utf-8") as f:
+            toks = f.read().split()
+        nrow, ncol, nonzero = int(toks[0]), int(toks[1]), int(toks[2])
+        if (nrow, ncol) != w.shape:
+            raise ValueError(
+                "FixConnLayer: fixconn_weight shape does not match "
+                "architecture")
+        vals = toks[3:3 + 3 * nonzero]
+        for i in range(nonzero):
+            x, y, v = int(vals[3 * i]), int(vals[3 * i + 1]), float(
+                vals[3 * i + 2])
+            w[x, y] = v
+        self._wmat = w
+        return [(in_shapes[0][0], 1, 1, self.param.num_hidden)]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        b = x.shape[0]
+        m = x.reshape(b, -1)
+        # frozen constant weight: stop_gradient keeps it out of the grads
+        w = jax.lax.stop_gradient(jnp.asarray(self._wmat))
+        out = m @ w.T
+        return [out.reshape(b, 1, 1, -1)]
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+@register_layer
+class ConvolutionLayer(Layer):
+    """conv (src/layer/convolution_layer-inl.hpp:13-228).
+
+    Weight stored natively as OIHW (nchannel, in_ch/ngroup, ky, kx); the
+    reference's (ngroup, out/g, in/g*ky*kx) 3-D layout is the same memory
+    order, used only at checkpoint conversion. Grouped conv maps to
+    feature_group_count (no im2col on TPU).
+    """
+
+    type_name = "conv"
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        b, c, h, w = in_shapes[0]
+        p = self.param
+        if c % p.num_group != 0:
+            raise ValueError("input channels must divide group size")
+        if p.num_channel % p.num_group != 0:
+            raise ValueError("output channels must divide group size")
+        if p.num_channel <= 0:
+            raise ValueError("must set nchannel correctly")
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError("must set kernel_size correctly")
+        if p.kernel_width > w or p.kernel_height > h:
+            raise ValueError("kernel size exceeds input")
+        p.num_input_channel = c
+        oh = ops.conv_out_dim(h, p.kernel_height, p.stride, p.pad_y)
+        ow = ops.conv_out_dim(w, p.kernel_width, p.stride, p.pad_x)
+        return [(b, p.num_channel, oh, ow)]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        p = self.param
+        c = in_shapes[0][1]
+        ipg = c // p.num_group
+        shape = (p.num_channel, ipg, p.kernel_height, p.kernel_width)
+        # reference init args: in = in/g*ky*kx, out = out/g (InitModel:27-32)
+        wmat = p.rand_init_weight(
+            key, shape,
+            in_num=ipg * p.kernel_height * p.kernel_width,
+            out_num=p.num_channel // p.num_group)
+        params = {"wmat": wmat}
+        if p.no_bias == 0:
+            params["bias"] = jnp.full((p.num_channel,), p.init_bias,
+                                      dtype=jnp.float32)
+        return params
+
+    def param_tags(self) -> Dict[str, str]:
+        return {"wmat": "wmat", "bias": "bias"}
+
+    def apply(self, params, inputs, *, train, rng=None):
+        p = self.param
+        out = ops.conv2d(inputs[0], params["wmat"], p.stride, p.pad_y,
+                         p.pad_x, p.num_group)
+        if "bias" in params:
+            out = out + params["bias"][None, :, None, None]
+        return [out]
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+class PoolingLayer(Layer):
+    """max/sum/avg pooling (src/layer/pooling_layer-inl.hpp:17-114)."""
+
+    mode = "max"
+    pre_relu = False
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        b, c, h, w = in_shapes[0]
+        p = self.param
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError("must set kernel_size correctly")
+        if p.kernel_width > w or p.kernel_height > h:
+            raise ValueError("kernel size exceeds input")
+        oh = ops.pool_out_dim(h, p.kernel_height, p.stride)
+        ow = ops.pool_out_dim(w, p.kernel_width, p.stride)
+        return [(b, c, oh, ow)]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        if self.pre_relu:
+            x = ops.relu(x)
+        p = self.param
+        return [ops.pool2d(x, self.mode, p.kernel_height, p.kernel_width,
+                           p.stride)]
+
+
+@register_layer
+class MaxPoolingLayer(PoolingLayer):
+    type_name = "max_pooling"
+    mode = "max"
+
+
+@register_layer
+class SumPoolingLayer(PoolingLayer):
+    type_name = "sum_pooling"
+    mode = "sum"
+
+
+@register_layer
+class AvgPoolingLayer(PoolingLayer):
+    type_name = "avg_pooling"
+    mode = "avg"
+
+
+@register_layer
+class ReluMaxPoolingLayer(PoolingLayer):
+    """relu fused before max pooling (layer_impl-inl.hpp:55-56)."""
+    type_name = "relu_max_pooling"
+    mode = "max"
+    pre_relu = True
+
+
+@register_layer
+class InsanityPoolingLayer(PoolingLayer):
+    """insanity_max_pooling (src/layer/insanity_pooling_layer-inl.hpp):
+    stochastic displaced max pooling at train, plain max pooling at eval.
+    Param `keep` = probability a source pixel is read in place."""
+
+    type_name = "insanity_max_pooling"
+    mode = "max"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.p_keep = 1.0
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "keep":
+            self.p_keep = float(val)
+
+    def apply(self, params, inputs, *, train, rng=None):
+        p = self.param
+        if train:
+            return [ops.insanity_pool2d(inputs[0], rng, p.kernel_height,
+                                        p.kernel_width, p.stride,
+                                        self.p_keep)]
+        return [ops.pool2d(inputs[0], "max", p.kernel_height, p.kernel_width,
+                           p.stride)]
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+class ActivationLayer(Layer):
+    """relu/sigmoid/tanh/softplus (activation_layer-inl.hpp:12-41)."""
+
+    fn = staticmethod(ops.relu)
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        return [self.fn(inputs[0])]
+
+
+@register_layer
+class ReluLayer(ActivationLayer):
+    type_name = "relu"
+    fn = staticmethod(ops.relu)
+
+
+@register_layer
+class SigmoidLayer(ActivationLayer):
+    type_name = "sigmoid"
+    fn = staticmethod(ops.sigmoid)
+
+
+@register_layer
+class TanhLayer(ActivationLayer):
+    type_name = "tanh"
+    fn = staticmethod(ops.tanh)
+
+
+@register_layer
+class SoftplusLayer(ActivationLayer):
+    type_name = "softplus"
+    fn = staticmethod(ops.softplus)
+
+
+@register_layer
+class XeluLayer(ActivationLayer):
+    """xelu: x > 0 ? x : x / b, b default 5.0 (xelu_layer-inl.hpp:15-53)."""
+
+    type_name = "xelu"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.b = 5.0
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "b":
+            self.b = float(val)
+
+    def apply(self, params, inputs, *, train, rng=None):
+        return [ops.xelu(inputs[0], self.b)]
+
+
+@register_layer
+class InsanityLayer(ActivationLayer):
+    """insanity / RReLU (insanity_layer-inl.hpp:14-102).
+
+    Train: xelu with per-element random divisor uniform in [lb, ub];
+    eval: fixed divisor (lb+ub)/2. The [lb, ub] range anneals toward its
+    midpoint between calm_start and calm_end; the reference advances the
+    annealing once per Forward call - here `anneal_step()` is invoked by the
+    trainer once per round (per-round rather than per-batch granularity,
+    since lb/ub are compile-time constants of the jitted step).
+    """
+
+    type_name = "insanity"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.lb = 5.0
+        self.ub = 10.0
+        self.saturation_start = 0
+        self.saturation_end = 0
+        self._step = 0
+        self._delta: Optional[float] = None
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "lb":
+            self.lb = float(val)
+        if name == "ub":
+            self.ub = float(val)
+        if name == "calm_start":
+            self.saturation_start = int(val)
+        if name == "calm_end":
+            self.saturation_end = int(val)
+
+    def anneal_step(self) -> None:
+        if self._delta is None:
+            mid = (self.ub + self.lb) / 2.0
+            span = self.saturation_end - self.saturation_start
+            self._delta = (self.ub - mid) / span if span else 0.0
+        if self.saturation_start < self._step < self.saturation_end:
+            self.ub -= self._delta * self._step
+            self.lb += self._delta * self._step
+        self._step += 1
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        if train:
+            u = jax.random.uniform(rng, x.shape, dtype=x.dtype)
+            divisor = u * (self.ub - self.lb) + self.lb
+            return [ops.xelu(x, divisor)]
+        return [ops.xelu(x, (self.lb + self.ub) / 2.0)]
+
+
+@register_layer
+class PReluLayer(Layer):
+    """prelu (src/layer/prelu_layer-inl.hpp:48-173).
+
+    Learnable per-channel slope (per-feature for matrix nodes), clipped to
+    [0,1]; at train an optional multiplicative noise uniform in
+    [1-random, 1+random] perturbs the slope. out = x>0 ? x : x*slope.
+    """
+
+    type_name = "prelu"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.init_slope = 0.25
+        self.init_random = 0
+        self.random = 0.0
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "init_slope":
+            self.init_slope = float(val)
+        if name == "random_slope":
+            self.init_random = int(val)
+        if name == "random":
+            self.random = float(val)
+
+    def _channels(self, shape: Shape) -> int:
+        return shape[3] if shape[1] == 1 else shape[1]
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        return [in_shapes[0]]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        c = self._channels(in_shapes[0])
+        if self.init_random == 0:
+            slope = jnp.full((c,), self.init_slope, dtype=jnp.float32)
+        else:
+            slope = self.init_slope * jax.random.uniform(
+                key, (c,), dtype=jnp.float32)
+        return {"slope": slope}
+
+    def param_tags(self) -> Dict[str, str]:
+        # reference visits the slope under the "bias" tag
+        # (prelu_layer-inl.hpp ApplyVisitor)
+        return {"slope": "bias"}
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        slope = params["slope"]
+        if x.shape[1] != 1:
+            mask = slope[None, :, None, None]
+        else:
+            mask = slope[None, None, None, :]
+        mask = jnp.broadcast_to(mask, x.shape)
+        if train and self.random > 0:
+            noise = 1 + (jax.random.uniform(rng, x.shape, dtype=x.dtype)
+                         * self.random * 2.0 - self.random)
+            mask = mask * noise
+        mask = jnp.clip(mask, 0.0, 1.0)
+        return [ops.mxelu(x, mask)]
+
+
+# ---------------------------------------------------------------------------
+# normalization / regularization
+# ---------------------------------------------------------------------------
+
+@register_layer
+class BatchNormLayer(Layer):
+    """batch_norm (src/layer/batch_norm_layer-inl.hpp:14-197).
+
+    Per-channel for conv nodes, per-feature for matrix nodes. The reference
+    ALWAYS normalizes by the current minibatch statistics - even at eval
+    (there is no running mean/var; its eval branch is just an algebraic
+    rearrangement of the train branch). We preserve that quirk: train and
+    eval compute identically.
+    """
+
+    type_name = "batch_norm"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.init_slope = 1.0
+        self.init_bias = 0.0
+        self.eps = 1e-10
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "init_slope":
+            self.init_slope = float(val)
+        if name == "init_bias":
+            self.init_bias = float(val)
+        if name == "eps":
+            self.eps = float(val)
+
+    def _axes(self, shape: Shape):
+        # conv node: stats over (b, h, w) per channel; matrix node: over b
+        if shape[1] != 1:
+            return (0, 2, 3), (None, slice(None), None, None)
+        return (0, 1, 2), (None, None, None, slice(None))
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        return [in_shapes[0]]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        shape = in_shapes[0]
+        c = shape[3] if shape[1] == 1 else shape[1]
+        return {
+            "slope": jnp.full((c,), self.init_slope, dtype=jnp.float32),
+            "bias": jnp.full((c,), self.init_bias, dtype=jnp.float32),
+        }
+
+    def param_tags(self) -> Dict[str, str]:
+        return {"slope": "wmat", "bias": "bias"}
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        axes, _ = self._axes(x.shape)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        if x.shape[1] != 1:
+            slope = params["slope"][None, :, None, None]
+            bias = params["bias"][None, :, None, None]
+        else:
+            slope = params["slope"][None, None, None, :]
+            bias = params["bias"][None, None, None, :]
+        return [xhat * slope + bias]
+
+
+@register_layer
+class LRNLayer(Layer):
+    """lrn (src/layer/lrn_layer-inl.hpp:12-93)."""
+
+    type_name = "lrn"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.local_size = 3
+        self.alpha = 0.001
+        self.beta = 0.75
+        self.knorm = 1.0
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "local_size":
+            self.local_size = int(val)
+        if name == "alpha":
+            self.alpha = float(val)
+        if name == "beta":
+            self.beta = float(val)
+        if name == "knorm":
+            self.knorm = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        return [ops.lrn(inputs[0], self.local_size, self.alpha, self.beta,
+                        self.knorm)]
+
+
+@register_layer
+class DropoutLayer(Layer):
+    """dropout (src/layer/dropout_layer-inl.hpp:12-66): inverted dropout,
+    self-loop; identity at eval."""
+
+    type_name = "dropout"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.threshold = 0.0
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "threshold":
+            self.threshold = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        if not 0.0 <= self.threshold < 1.0:
+            raise ValueError("DropoutLayer: invalid dropout threshold")
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        if not train or self.threshold == 0.0:
+            return [x]
+        pkeep = 1.0 - self.threshold
+        mask = (jax.random.uniform(rng, x.shape, dtype=x.dtype)
+                < pkeep).astype(x.dtype) / pkeep
+        return [x * mask]
+
+
+@register_layer
+class BiasLayer(Layer):
+    """bias (src/layer/bias_layer-inl.hpp): self-loop additive bias on
+    matrix nodes."""
+
+    type_name = "bias"
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        if not is_mat(in_shapes[0]):
+            raise ValueError("BiasLayer only works on flattened nodes")
+        self.param.num_input_node = in_shapes[0][3]
+        return [in_shapes[0]]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        n = in_shapes[0][3]
+        return {"bias": jnp.full((n,), self.param.init_bias,
+                                 dtype=jnp.float32)}
+
+    def param_tags(self) -> Dict[str, str]:
+        return {"bias": "bias"}
+
+    def apply(self, params, inputs, *, train, rng=None):
+        return [inputs[0] + params["bias"][None, None, None, :]]
+
+
+# ---------------------------------------------------------------------------
+# structural layers
+# ---------------------------------------------------------------------------
+
+@register_layer
+class FlattenLayer(Layer):
+    """flatten (src/layer/flatten_layer-inl.hpp): (b,c,h,w)->(b,1,1,chw)."""
+
+    type_name = "flatten"
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        b, c, h, w = in_shapes[0]
+        return [(b, 1, 1, c * h * w)]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        return [x.reshape(x.shape[0], 1, 1, -1)]
+
+
+@register_layer
+class SplitLayer(Layer):
+    """split (src/layer/split_layer-inl.hpp): 1->N copies; autodiff sums
+    the output grads, exactly the reference backward."""
+
+    type_name = "split"
+    num_out = 1  # set by NetConfig from the connection arity
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        return [in_shapes[0]] * self.num_out
+
+    def apply(self, params, inputs, *, train, rng=None):
+        return [inputs[0]] * self.num_out
+
+
+class ConcatBase(Layer):
+    dim = 3
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        if len(in_shapes) < 2:
+            raise ValueError("Concat layer only supports n-1 connection")
+        if len(in_shapes) > 4:
+            raise ValueError("more than 4 input nodes is unsupported")
+        out = list(in_shapes[0])
+        total = 0
+        for s in in_shapes:
+            total += s[self.dim]
+            for j in range(4):
+                if j != self.dim and s[j] != out[j]:
+                    raise ValueError("Concat shape doesn't match")
+        out[self.dim] = total
+        return [tuple(out)]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        return [jnp.concatenate(inputs, axis=self.dim)]
+
+
+@register_layer
+class ConcatLayer(ConcatBase):
+    """concat along the feature dim (concat_layer-inl.hpp, dim=3)."""
+    type_name = "concat"
+    dim = 3
+
+
+@register_layer
+class ChConcatLayer(ConcatBase):
+    """ch_concat along the channel dim (concat_layer-inl.hpp, dim=1)."""
+    type_name = "ch_concat"
+    dim = 1

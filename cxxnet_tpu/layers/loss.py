@@ -1,0 +1,106 @@
+"""Loss layers.
+
+In the reference these are self-loop layers that transform activations in
+the forward pass and overwrite them with gradients in the backward pass
+(loss_layer_base-inl.hpp:31-104). Functionally, each loss layer provides:
+
+- forward_transform(x): what Predict/Evaluate see (softmax probs, sigmoid);
+- per_example_loss(x, label): a scalar per instance whose gradient w.r.t.
+  the raw input x equals the reference's hand-written gradient:
+    softmax:        d/dx CE          = softmax(x) - onehot(label)
+    l2_loss:        d/dx 0.5||x-y||^2 = x - y
+    multi_logistic: d/dx BCEwithlogits = sigmoid(x) - y
+
+The reference scales the gradient by grad_scale/(batch_size*update_period)
+(loss_layer_base-inl.hpp:60-63); the trainer applies the same scale to the
+summed loss, so the resulting parameter gradients are identical.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.layers.base import Layer, Shape, register_layer
+
+
+class LossLayer(Layer):
+    """Base loss layer (self-loop)."""
+
+    is_loss = True
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.target = "label"
+        self.grad_scale = 1.0
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "target":
+            self.target = val
+        if name == "grad_scale":
+            self.grad_scale = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        b = x.shape[0]
+        m = x.reshape(b, -1)
+        return [self.forward_transform(m).reshape(x.shape)]
+
+    # --- loss interface ---------------------------------------------------
+    def forward_transform(self, x: jax.Array) -> jax.Array:
+        return x
+
+    def per_example_loss(self, x: jax.Array, label: jax.Array) -> jax.Array:
+        """x: (n, k) raw pre-transform activations; label: (n, label_width).
+        Returns (n,) per-example losses."""
+        raise NotImplementedError
+
+
+@register_layer
+class SoftmaxLayer(LossLayer):
+    """softmax + cross entropy (loss/softmax_layer-inl.hpp:12-33)."""
+
+    type_name = "softmax"
+
+    def forward_transform(self, x: jax.Array) -> jax.Array:
+        return jax.nn.softmax(x, axis=-1)
+
+    def per_example_loss(self, x: jax.Array, label: jax.Array) -> jax.Array:
+        lbl = label[:, 0].astype(jnp.int32)
+        logz = jax.nn.logsumexp(x, axis=-1)
+        picked = jnp.take_along_axis(x, lbl[:, None], axis=1)[:, 0]
+        return logz - picked
+
+
+@register_layer
+class L2LossLayer(LossLayer):
+    """l2_loss (loss/l2_loss_layer-inl.hpp): identity forward,
+    grad = pred - label."""
+
+    type_name = "l2_loss"
+
+    def per_example_loss(self, x: jax.Array, label: jax.Array) -> jax.Array:
+        diff = x - label
+        return 0.5 * jnp.sum(diff * diff, axis=-1)
+
+
+@register_layer
+class MultiLogisticLayer(LossLayer):
+    """multi_logistic (loss/multi_logistic_layer-inl.hpp): sigmoid forward,
+    grad = sigmoid(x) - label."""
+
+    type_name = "multi_logistic"
+
+    def forward_transform(self, x: jax.Array) -> jax.Array:
+        return jax.nn.sigmoid(x)
+
+    def per_example_loss(self, x: jax.Array, label: jax.Array) -> jax.Array:
+        # sum_j [softplus(x) - y*x]  (stable BCE-with-logits)
+        return jnp.sum(jax.nn.softplus(x) - label * x, axis=-1)

@@ -1,0 +1,21 @@
+"""TPU-first op vocabulary.
+
+The reference builds every layer from mshadow expression templates (dot,
+pool, chpool, unpack_patch2col, ...). Here the same vocabulary is provided
+as jax.numpy/lax functions that lower to XLA HLO: convolution goes straight
+to ConvGeneralDilated (no im2col, no temp_col_max chunking - the compiler
+tiles onto the MXU), pooling to reduce_window, LRN to a channel-window
+reduce, and backward passes everywhere come from jax.grad instead of the
+hand-written Backprop methods.
+"""
+
+from cxxnet_tpu.ops.pooling import pool2d, pool_out_dim, insanity_pool2d
+from cxxnet_tpu.ops.conv import conv2d, conv_out_dim
+from cxxnet_tpu.ops.nn import (
+    relu, sigmoid, tanh, softplus, xelu, mxelu, softmax, lrn)
+
+__all__ = [
+    "pool2d", "pool_out_dim", "insanity_pool2d",
+    "conv2d", "conv_out_dim",
+    "relu", "sigmoid", "tanh", "softplus", "xelu", "mxelu", "softmax", "lrn",
+]

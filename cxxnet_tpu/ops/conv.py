@@ -1,0 +1,37 @@
+"""2-D convolution on NCHW tensors.
+
+The reference lowers conv to im2col + per-group GEMM with chunking to bound
+scratch memory (convolution_layer-inl.hpp:70-155, temp_col_max). On TPU the
+whole dance is one lax.conv_general_dilated: XLA tiles it directly onto the
+MXU, grouped conv maps to feature_group_count, and no scratch bound exists.
+
+Output-size parity (convolution_layer-inl.hpp:174-177):
+    out = (in + 2*pad - k) // stride + 1
+which is exactly lax's explicit-padding convolution arithmetic.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def conv_out_dim(in_dim: int, ksize: int, stride: int, pad: int) -> int:
+    """The reference convolution output-size formula."""
+    return (in_dim + 2 * pad - ksize) // stride + 1
+
+
+def conv2d(x: jax.Array, w: jax.Array, stride: int, pad_y: int, pad_x: int,
+           num_group: int = 1, precision=None) -> jax.Array:
+    """Grouped 2-D convolution.
+
+    x: (batch, in_ch, h, w); w: (out_ch, in_ch // num_group, ky, kx).
+    """
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride, stride),
+        padding=((pad_y, pad_y), (pad_x, pad_x)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=num_group,
+        precision=precision,
+    )

@@ -1,0 +1,84 @@
+"""Pooling ops on NCHW tensors via lax.reduce_window.
+
+Output-size parity: the reference uses a ceil-flavored formula
+(pooling_layer-inl.hpp:103-106, mirrored by mshadow pool):
+
+    out = min(in - k + stride - 1, in - 1) // stride + 1
+
+i.e. the last window may be truncated at the boundary. We reproduce this
+with explicit high padding and neutral init values (-inf for max, 0 for
+sum/avg); avg pooling divides by the FULL window size k*k even for
+truncated windows, matching mshadow pool<sum> scaled by 1/(ky*kx).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pool_out_dim(in_dim: int, ksize: int, stride: int) -> int:
+    """The reference pooling output-size formula."""
+    return min(in_dim - ksize + stride - 1, in_dim - 1) // stride + 1
+
+
+def _pool_padding(in_dim: int, ksize: int, stride: int) -> int:
+    """High padding needed so reduce_window emits pool_out_dim outputs."""
+    out = pool_out_dim(in_dim, ksize, stride)
+    return max(0, (out - 1) * stride + ksize - in_dim)
+
+
+def pool2d(x: jax.Array, mode: str, ksize_y: int, ksize_x: int,
+           stride: int) -> jax.Array:
+    """Pool an NCHW tensor. mode in {'max', 'sum', 'avg'}."""
+    pad_y = _pool_padding(x.shape[2], ksize_y, stride)
+    pad_x = _pool_padding(x.shape[3], ksize_x, stride)
+    padding = ((0, 0), (0, 0), (0, pad_y), (0, pad_x))
+    window = (1, 1, ksize_y, ksize_x)
+    strides = (1, 1, stride, stride)
+    if mode == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides, padding)
+    elif mode in ("sum", "avg"):
+        out = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if mode == "avg":
+            out = out * (1.0 / (ksize_y * ksize_x))
+    else:
+        raise ValueError(f"unknown pooling mode {mode!r}")
+    return out
+
+
+def insanity_pool2d(x: jax.Array, rng: jax.Array, ksize_y: int, ksize_x: int,
+                    stride: int, p_keep: float) -> jax.Array:
+    """Stochastic displaced max pooling (insanity_max_pooling).
+
+    Parity with InsanityPoolingExp (insanity_pooling_layer-inl.hpp:13-101):
+    every source pixel draws a uniform flag; with probability p_keep it is
+    read in place, otherwise it is read from a neighbour one pixel
+    up/down/left/right (each with probability (1-p_keep)/4, clamped at the
+    border). Max pooling then runs over the displaced reads - which equals
+    max-pooling the "jittered" image.
+    """
+    b, c, h, w = x.shape
+    flag = jax.random.uniform(rng, (b, c, h, w), dtype=jnp.float32)
+    delta = (1.0 - p_keep) / 4.0
+
+    ys = jnp.broadcast_to(jnp.arange(h)[None, None, :, None], (b, c, h, w))
+    xs = jnp.broadcast_to(jnp.arange(w)[None, None, None, :], (b, c, h, w))
+
+    yd = jnp.where((flag >= p_keep) & (flag < p_keep + delta), -1,
+                   jnp.where((flag >= p_keep + delta) &
+                             (flag < p_keep + 2 * delta), 1, 0))
+    xd = jnp.where((flag >= p_keep + 2 * delta) &
+                   (flag < p_keep + 3 * delta), -1,
+                   jnp.where(flag >= p_keep + 3 * delta, 1, 0))
+    y_src = jnp.clip(ys + yd, 0, h - 1)
+    x_src = jnp.clip(xs + xd, 0, w - 1)
+
+    flat_idx = (y_src * w + x_src).reshape(b, c, h * w)
+    jittered = jnp.take_along_axis(
+        x.reshape(b, c, h * w), flat_idx, axis=2).reshape(b, c, h, w)
+    return pool2d(jittered, "max", ksize_y, ksize_x, stride)

@@ -7,9 +7,15 @@ one - SURVEY.md par.4). Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The TPU tunnel's sitecustomize imports jax before pytest starts, so the
+# env var alone may be read too late; force the platform via the config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,395 @@
+"""Layer numerics and shape-inference tests vs torch/numpy references."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+from cxxnet_tpu.layers import create_layer, known_layer_types
+
+
+def make(type_name, cfg=(), name=""):
+    layer = create_layer(type_name, name)
+    for k, v in cfg:
+        layer.set_param(k, v)
+    return layer
+
+
+def run(layer, xs, train=False, seed=0, params=None):
+    shapes = [x.shape for x in xs]
+    layer.infer_shapes(list(shapes))
+    if params is None:
+        params = layer.init_params(jax.random.PRNGKey(seed), list(shapes))
+    outs = layer.apply(params, [jnp.asarray(x) for x in xs], train=train,
+                       rng=jax.random.PRNGKey(seed + 1))
+    return [np.asarray(o) for o in outs], params
+
+
+def test_registry_covers_reference_types():
+    expected = {
+        "fullc", "fixconn", "bias", "softmax", "relu", "sigmoid", "tanh",
+        "softplus", "flatten", "dropout", "conv", "relu_max_pooling",
+        "max_pooling", "sum_pooling", "avg_pooling", "lrn", "concat",
+        "xelu", "split", "insanity", "insanity_max_pooling", "l2_loss",
+        "multi_logistic", "ch_concat", "prelu", "batch_norm",
+    }
+    assert expected <= set(known_layer_types())
+
+
+# ---------------------------------------------------------------------------
+# fullc
+# ---------------------------------------------------------------------------
+
+def test_fullc_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 1, 1, 7).astype(np.float32)
+    layer = make("fullc", [("nhidden", "5"), ("init_bias", "0.5")])
+    (out,), params = run(layer, [x])
+    expect = x.reshape(4, 7) @ np.asarray(params["wmat"]).T + 0.5
+    np.testing.assert_allclose(out.reshape(4, 5), expect, rtol=1e-5)
+    assert np.asarray(params["wmat"]).shape == (5, 7)
+
+
+def test_fullc_no_bias():
+    x = np.ones((2, 1, 1, 3), dtype=np.float32)
+    layer = make("fullc", [("nhidden", "4"), ("no_bias", "1")])
+    (_, ), params = run(layer, [x])
+    assert "bias" not in params
+
+
+def test_fullc_rejects_non_matrix():
+    layer = make("fullc", [("nhidden", "4")])
+    with pytest.raises(ValueError):
+        layer.infer_shapes([(2, 3, 4, 4)])
+
+
+# ---------------------------------------------------------------------------
+# conv
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("h,w,k,s,p", [
+    (28, 28, 3, 2, 1), (27, 27, 5, 1, 2), (11, 13, 3, 3, 0), (227, 227, 11, 4, 0),
+])
+def test_conv_output_shape_formula(h, w, k, s, p):
+    layer = make("conv", [("kernel_size", str(k)), ("stride", str(s)),
+                          ("pad", str(p)), ("nchannel", "4")])
+    (out_shape,) = layer.infer_shapes([(2, 3, h, w)])
+    assert out_shape == (2, 4, (h + 2 * p - k) // s + 1,
+                         (w + 2 * p - k) // s + 1)
+
+
+def test_conv_matches_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    layer = make("conv", [("kernel_size", "3"), ("stride", "2"),
+                          ("pad", "1"), ("nchannel", "6")])
+    (out,), params = run(layer, [x])
+    w = np.asarray(params["wmat"])
+    b = np.asarray(params["bias"])
+    expect = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                      torch.from_numpy(b), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_conv_matches_torch():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    layer = make("conv", [("kernel_size", "3"), ("ngroup", "2"),
+                          ("nchannel", "6"), ("no_bias", "1")])
+    (out,), params = run(layer, [x])
+    w = np.asarray(params["wmat"])
+    assert w.shape == (6, 2, 3, 3)
+    expect = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                      groups=2).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("h,k,s", [(28, 3, 2), (27, 3, 2), (13, 3, 2),
+                                   (6, 2, 2), (7, 3, 3), (5, 5, 1)])
+def test_pool_output_shape_formula(h, k, s):
+    layer = make("max_pooling", [("kernel_size", str(k)), ("stride", str(s))])
+    (out_shape,) = layer.infer_shapes([(1, 2, h, h)])
+    expect = min(h - k + s - 1, h - 1) // s + 1
+    assert out_shape == (1, 2, expect, expect)
+
+
+def test_max_pooling_values():
+    # 13 -> ceil-style output 7 with truncated last window (torch ceil_mode)
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 13, 13).astype(np.float32)
+    layer = make("max_pooling", [("kernel_size", "3"), ("stride", "2")])
+    (out,), _ = run(layer, [x])
+    expect = F.max_pool2d(torch.from_numpy(x), 3, 2, ceil_mode=True).numpy()
+    assert out.shape == expect.shape
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_avg_pooling_divides_by_full_window():
+    x = np.ones((1, 1, 6, 6), dtype=np.float32)
+    layer = make("avg_pooling", [("kernel_size", "3"), ("stride", "2")])
+    (out,), _ = run(layer, [x])
+    # out = min(6-3+1, 5)//2 + 1 = 3; last window [4,7) truncated to 2 elems
+    # but still divides by 9 (reference scales by 1/(ky*kx))
+    assert out.shape == (1, 1, 3, 3)
+    np.testing.assert_allclose(out[0, 0, 0, 0], 1.0)
+    np.testing.assert_allclose(out[0, 0, 2, 2], 4.0 / 9.0)
+
+
+def test_relu_max_pooling_fuses_relu():
+    x = -np.ones((1, 1, 4, 4), dtype=np.float32)
+    layer = make("relu_max_pooling", [("kernel_size", "2"), ("stride", "2")])
+    (out,), _ = run(layer, [x])
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_insanity_pooling_eval_is_max_pool():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    layer = make("insanity_max_pooling",
+                 [("kernel_size", "2"), ("stride", "2"), ("keep", "0.5")])
+    (out_eval,), _ = run(layer, [x], train=False)
+    ref = make("max_pooling", [("kernel_size", "2"), ("stride", "2")])
+    (out_ref,), _ = run(ref, [x])
+    np.testing.assert_allclose(out_eval, out_ref)
+    # train mode with keep=1.0 must equal plain max pooling too
+    layer2 = make("insanity_max_pooling",
+                  [("kernel_size", "2"), ("stride", "2"), ("keep", "1.0")])
+    (out_train,), _ = run(layer2, [x], train=True)
+    np.testing.assert_allclose(out_train, out_ref)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def test_activations_match_torch():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    t = torch.from_numpy(x)
+    cases = {
+        "relu": F.relu(t), "sigmoid": torch.sigmoid(t),
+        "tanh": torch.tanh(t), "softplus": F.softplus(t),
+    }
+    for name, expect in cases.items():
+        (out,), _ = run(make(name), [x])
+        np.testing.assert_allclose(out, expect.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_xelu():
+    x = np.array([[[[-10.0, 10.0]]]], dtype=np.float32)
+    (out,), _ = run(make("xelu", [("b", "5")]), [x])
+    np.testing.assert_allclose(out, [[[[-2.0, 10.0]]]])
+
+
+def test_insanity_eval_uses_midpoint():
+    x = np.array([[[[-6.0, 6.0]]]], dtype=np.float32)
+    layer = make("insanity", [("lb", "2"), ("ub", "4")])
+    (out,), _ = run(layer, [x], train=False)
+    np.testing.assert_allclose(out, [[[[-2.0, 6.0]]]])
+
+
+def test_insanity_train_bounds():
+    rng = np.random.RandomState(6)
+    x = -np.abs(rng.randn(1, 1, 50, 50)).astype(np.float32)
+    layer = make("insanity", [("lb", "2"), ("ub", "4")])
+    (out,), _ = run(layer, [x], train=True)
+    ratio = out / x  # in [1/4, 1/2]
+    assert np.all(ratio >= 1 / 4 - 1e-6) and np.all(ratio <= 1 / 2 + 1e-6)
+
+
+def test_prelu_conv_and_fc_modes():
+    x = np.array([[[[-2.0]], [[4.0]]]], dtype=np.float32)  # (1,2,1,1)
+    layer = make("prelu", [("init_slope", "0.25")])
+    (out,), params = run(layer, [x])
+    np.testing.assert_allclose(out, [[[[-0.5]], [[4.0]]]])
+    assert np.asarray(params["slope"]).shape == (2,)
+
+    xf = np.array([[[[-2.0, 4.0]]]], dtype=np.float32)  # (1,1,1,2) matrix
+    (outf,), paramsf = run(make("prelu"), [xf])
+    np.testing.assert_allclose(outf, [[[[-0.5, 4.0]]]])
+    assert np.asarray(paramsf["slope"]).shape == (2,)
+
+
+# ---------------------------------------------------------------------------
+# batch norm / lrn
+# ---------------------------------------------------------------------------
+
+def test_batch_norm_conv_matches_torch_batch_stats():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 3, 5, 5).astype(np.float32)
+    layer = make("batch_norm", [("eps", "1e-5")])
+    (out,), params = run(layer, [x])
+    expect = F.batch_norm(
+        torch.from_numpy(x), None, None,
+        torch.ones(3), torch.zeros(3), training=True, eps=1e-5).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_eval_still_uses_batch_stats():
+    """Reference quirk: no running stats; eval == train numerics."""
+    rng = np.random.RandomState(8)
+    x = rng.randn(4, 3, 5, 5).astype(np.float32)
+    layer = make("batch_norm")
+    (out_train,), p = run(layer, [x], train=True)
+    (out_eval,), _ = run(layer, [x], train=False, params=p)
+    np.testing.assert_allclose(out_train, out_eval, rtol=1e-6)
+
+
+def test_batch_norm_fc_normalizes_features():
+    rng = np.random.RandomState(9)
+    x = rng.randn(16, 1, 1, 6).astype(np.float32)
+    (out,), params = run(make("batch_norm", [("eps", "1e-5")]), [x])
+    assert np.asarray(params["slope"]).shape == (6,)
+    m = out.reshape(16, 6)
+    np.testing.assert_allclose(m.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(m.std(axis=0), 1.0, atol=1e-3)
+
+
+def test_lrn_matches_torch():
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 8, 4, 4).astype(np.float32)
+    layer = make("lrn", [("local_size", "5"), ("alpha", "0.001"),
+                         ("beta", "0.75"), ("knorm", "1")])
+    (out,), _ = run(layer, [x])
+    expect = F.local_response_norm(torch.from_numpy(x), 5, alpha=0.001,
+                                   beta=0.75, k=1.0).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# dropout / bias / structural
+# ---------------------------------------------------------------------------
+
+def test_dropout_eval_identity_train_mask():
+    rng = np.random.RandomState(11)
+    x = rng.randn(2, 1, 1, 1000).astype(np.float32) + 5.0
+    layer = make("dropout", [("threshold", "0.5")])
+    (out_eval,), _ = run(layer, [x], train=False)
+    np.testing.assert_allclose(out_eval, x)
+    (out_train,), _ = run(layer, [x], train=True)
+    kept = out_train != 0
+    assert 0.3 < kept.mean() < 0.7  # ~half kept
+    np.testing.assert_allclose(out_train[kept], (x * 2.0)[kept], rtol=1e-6)
+
+
+def test_bias_layer():
+    x = np.zeros((2, 1, 1, 3), dtype=np.float32)
+    layer = make("bias", [("init_bias", "1.5")])
+    (out,), _ = run(layer, [x])
+    np.testing.assert_allclose(out, 1.5)
+
+
+def test_flatten_roundtrip():
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    (out,), _ = run(make("flatten"), [x])
+    assert out.shape == (2, 1, 1, 60)
+    np.testing.assert_allclose(out.reshape(2, 3, 4, 5), x)
+
+
+def test_split_and_concat():
+    rng = np.random.RandomState(13)
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    split = make("split")
+    split.num_out = 3
+    outs, _ = run(split, [x])
+    assert len(outs) == 3
+    for o in outs:
+        np.testing.assert_allclose(o, x)
+
+    y = rng.randn(2, 5, 4, 4).astype(np.float32)
+    (cat,), _ = run(make("ch_concat"), [x, y])
+    assert cat.shape == (2, 8, 4, 4)
+    np.testing.assert_allclose(cat[:, :3], x)
+    np.testing.assert_allclose(cat[:, 3:], y)
+
+    a = rng.randn(2, 1, 1, 4).astype(np.float32)
+    b = rng.randn(2, 1, 1, 6).astype(np.float32)
+    (cat2,), _ = run(make("concat"), [a, b])
+    assert cat2.shape == (2, 1, 1, 10)
+
+
+def test_fixconn(tmp_path):
+    # sparse text format: nrow ncol nnz then (row col val) triples
+    fname = tmp_path / "w.txt"
+    fname.write_text("2 3 2\n0 1 2.0\n1 2 -1.0\n")
+    layer = make("fixconn", [("nhidden", "2"),
+                             ("fixconn_weight", str(fname))])
+    x = np.array([[[[1.0, 2.0, 3.0]]]], dtype=np.float32)
+    (out,), _ = run(layer, [x])
+    np.testing.assert_allclose(out.reshape(2), [4.0, -3.0])
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def test_softmax_forward_and_grad():
+    rng = np.random.RandomState(14)
+    x = rng.randn(3, 1, 1, 5).astype(np.float32)
+    layer = make("softmax")
+    (out,), _ = run(layer, [x])
+    expect = F.softmax(torch.from_numpy(x.reshape(3, 5)), dim=1).numpy()
+    np.testing.assert_allclose(out.reshape(3, 5), expect, rtol=1e-5)
+
+    # grad of per-example loss == softmax(x) - onehot (reference SetGradCPU)
+    label = np.array([[1], [4], [0]], dtype=np.float32)
+    g = jax.grad(lambda z: jnp.sum(layer.per_example_loss(
+        z, jnp.asarray(label))))(jnp.asarray(x.reshape(3, 5)))
+    onehot = np.eye(5)[label[:, 0].astype(int)]
+    np.testing.assert_allclose(np.asarray(g), expect - onehot, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_l2_loss_grad():
+    x = np.array([[1.0, 2.0]], dtype=np.float32)
+    label = np.array([[0.5, 1.0]], dtype=np.float32)
+    layer = make("l2_loss")
+    g = jax.grad(lambda z: jnp.sum(layer.per_example_loss(
+        z, jnp.asarray(label))))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), x - label, rtol=1e-6)
+
+
+def test_multi_logistic_grad():
+    rng = np.random.RandomState(15)
+    x = rng.randn(2, 4).astype(np.float32)
+    label = (rng.rand(2, 4) > 0.5).astype(np.float32)
+    layer = make("multi_logistic")
+    g = jax.grad(lambda z: jnp.sum(layer.per_example_loss(
+        z, jnp.asarray(label))))(jnp.asarray(x))
+    expect = 1 / (1 + np.exp(-x)) - label
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# weight init semantics
+# ---------------------------------------------------------------------------
+
+def test_gaussian_init_sigma():
+    layer = make("fullc", [("nhidden", "400"), ("init_sigma", "0.05")])
+    _, params = run(layer, [np.zeros((1, 1, 1, 300), np.float32)])
+    w = np.asarray(params["wmat"])
+    assert abs(w.std() - 0.05) < 0.005
+
+
+def test_xavier_init_bound():
+    layer = make("fullc", [("nhidden", "100"), ("random_type", "xavier")])
+    _, params = run(layer, [np.zeros((1, 1, 1, 200), np.float32)])
+    w = np.asarray(params["wmat"])
+    bound = np.sqrt(3.0 / (200 + 100))
+    assert np.all(np.abs(w) <= bound + 1e-6)
+    assert w.std() > bound / 3
+
+
+def test_kaiming_init_fullc_uses_nhidden():
+    layer = make("fullc", [("nhidden", "800"), ("random_type", "kaiming")])
+    _, params = run(layer, [np.zeros((1, 1, 1, 100), np.float32)])
+    w = np.asarray(params["wmat"])
+    assert abs(w.std() - np.sqrt(2.0 / 800)) < 0.01
