@@ -1,0 +1,6 @@
+"""Trainer core: NetConfig DAG parsing, functional network, jitted trainer."""
+
+from cxxnet_tpu.nnet.net_config import LayerInfo, NetConfig
+from cxxnet_tpu.nnet.network import Network, param_key
+
+__all__ = ["LayerInfo", "NetConfig", "Network", "param_key"]
