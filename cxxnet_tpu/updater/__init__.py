@@ -1,0 +1,10 @@
+"""Updaters: SGD / NAG / Adam with the reference's schedule semantics."""
+
+from cxxnet_tpu.updater.param import UpdaterParam
+from cxxnet_tpu.updater.updaters import (
+    Updater, create_updater, SGDUpdater, NAGUpdater, AdamUpdater)
+
+__all__ = [
+    "UpdaterParam", "Updater", "create_updater",
+    "SGDUpdater", "NAGUpdater", "AdamUpdater",
+]

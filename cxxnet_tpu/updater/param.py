@@ -1,0 +1,121 @@
+"""UpdaterParam: learning-rate/momentum schedules + tag scoping.
+
+Parity with src/updater/param.h:13-133:
+
+- params: lr|eta, wd, momentum, clip_gradient, momentum_schedule,
+  base/final_momentum, saturation_epoch, lr:schedule|gamma|alpha|step|
+  factor|minimum_lr|start_epoch.
+- tag scoping: a param set as "<tag>:<name>" (e.g. `wmat:lr`, `bias:wd`)
+  only applies to updaters whose tag matches - the prefix is stripped and
+  the rest processed normally (param.h:100-105).
+- schedules (ScheduleEpoch, param.h:76-94), `epoch` = number of updates:
+    constant:  lr = base_lr
+    expdecay:  lr = base_lr * gamma^(epoch / step)        (continuous)
+    polydecay: lr = base_lr * (1 + (epoch//step)*gamma)^(-alpha)
+    factor:    lr = base_lr * factor^(epoch // step)      (integer div)
+  then lr clamped to >= minimum_lr; epochs before start_epoch use base_lr.
+- momentum schedule: the reference statefully accumulates
+  `momentum += (final-base)/saturation*epoch + base` each update then
+  clamps to final_momentum - after the very first scheduled update it is
+  already clamped for all practical settings, so the stateless equivalent
+  used here evaluates the same expression from the current epoch and
+  clamps identically.
+
+Schedule math is written in jax.numpy so `epoch` may be a traced scalar
+inside the jitted train step (no recompilation per epoch).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_SCHEDULES = {"constant": 0, "expdecay": 1, "polydecay": 2, "factor": 3}
+
+
+class UpdaterParam:
+    def __init__(self, tag: str = ""):
+        self.tag = tag
+        self.base_lr = 0.01
+        self.wd = 0.0
+        self.momentum = 0.9
+        self.clip_gradient = 0.0
+        self.lr_schedule = 0
+        self.momentum_schedule = 0
+        self.lr_step = 1
+        self.lr_gamma = 0.5
+        self.lr_alpha = 0.5
+        self.lr_factor = 0.1
+        self.lr_minimum = 0.00001
+        self.start_epoch = 0
+        self.base_momentum = 0.5
+        self.final_momentum = 0.90
+        self.saturation_epoch = 0
+        self.silent = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if self.tag and name.startswith(self.tag + ":"):
+            name = name[len(self.tag) + 1:]
+        if name == "lr" or name == "eta":
+            self.base_lr = float(val)
+        if name == "wd":
+            self.wd = float(val)
+        if name == "momentum":
+            self.momentum = float(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "momentum_schedule":
+            self.momentum_schedule = int(val)
+        if name == "clip_gradient":
+            self.clip_gradient = float(val)
+        if name == "final_momentum":
+            self.final_momentum = float(val)
+        if name == "base_momentum":
+            self.base_momentum = float(val)
+        if name == "saturation_epoch":
+            self.saturation_epoch = int(val)
+        for prefix in ("lr:", "eta:"):
+            if name.startswith(prefix):
+                sub = name[len(prefix):]
+                if sub == "schedule":
+                    if val in _SCHEDULES:
+                        self.lr_schedule = _SCHEDULES[val]
+                if sub == "gamma":
+                    self.lr_gamma = float(val)
+                if sub == "alpha":
+                    self.lr_alpha = float(val)
+                if sub == "step":
+                    self.lr_step = int(val)
+                if sub == "factor":
+                    self.lr_factor = float(val)
+                if sub == "minimum_lr":
+                    self.lr_minimum = float(val)
+                if sub == "start_epoch":
+                    self.start_epoch = int(val)
+
+    # ------------------------------------------------------------------
+    def schedule(self, epoch):
+        """Return (learning_rate, momentum) at `epoch` (may be traced)."""
+        epoch = jnp.asarray(epoch, dtype=jnp.float32)
+        if self.lr_schedule == 0:
+            lr = jnp.full_like(epoch, self.base_lr)
+        elif self.lr_schedule == 1:
+            lr = self.base_lr * jnp.power(self.lr_gamma,
+                                          epoch / self.lr_step)
+        elif self.lr_schedule == 2:
+            steps = jnp.floor(epoch / self.lr_step)
+            lr = self.base_lr * jnp.power(1.0 + steps * self.lr_gamma,
+                                          -self.lr_alpha)
+        elif self.lr_schedule == 3:
+            steps = jnp.floor(epoch / self.lr_step)
+            lr = self.base_lr * jnp.power(self.lr_factor, steps)
+        else:
+            raise ValueError("unknown schedule type")
+
+        momentum = jnp.full_like(epoch, self.momentum)
+        if self.momentum_schedule and self.saturation_epoch:
+            momentum = (momentum + (self.final_momentum - self.base_momentum)
+                        / self.saturation_epoch * epoch + self.base_momentum)
+        momentum = jnp.minimum(momentum, self.final_momentum)
+        lr = jnp.maximum(lr, self.lr_minimum)
+        lr = jnp.where(epoch < self.start_epoch, self.base_lr, lr)
+        return lr, momentum

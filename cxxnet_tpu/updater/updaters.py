@@ -1,0 +1,116 @@
+"""SGD / NAG / Adam as pure per-tensor transforms.
+
+Each updater is a pure function (state, w, grad, epoch) -> (state', w')
+applied inside the jitted train step; the AsyncUpdater push/pull role of
+the reference collapses into "gradients are already all-reduced by the
+time this runs" (SURVEY.md par.2.7).
+
+Formula parity:
+- SGD   (sgd_updater-inl.hpp:72-84):
+    m = mom*m - lr*(clip(grad) + wd*w); w += m
+  where clip() clamps to +-clip_gradient and maps NaN -> 0 (:15-22).
+- NAG   (nag_updater-inl.hpp:65-72):
+    m_old = m; m = mom*m - lr*(grad + wd*w); w += (1+mom)*m - mom*m_old
+- Adam  (adam_updater-inl.hpp:17-83) with decay1/decay2 = 0.1/0.001
+  (beta expressed as 1-beta), bias-corrected lr, eps=1e-8, and the
+  reference's weight-decay sign quirk `grad -= wd*w` preserved.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.updater.param import UpdaterParam
+
+State = Dict[str, jax.Array]
+
+
+def _clip_nan(grad: jax.Array, bound: float) -> jax.Array:
+    """clip functor: clamp to [-bound, bound], NaN -> 0 (sgd_updater:15)."""
+    grad = jnp.where(jnp.isnan(grad), 0.0, grad)
+    return jnp.clip(grad, -bound, bound)
+
+
+class Updater:
+    """Base per-tensor updater bound to an UpdaterParam."""
+
+    kind = ""
+
+    def __init__(self, param: UpdaterParam):
+        self.param = param
+
+    def init_state(self, w: jax.Array) -> State:
+        raise NotImplementedError
+
+    def apply(self, state: State, w: jax.Array, grad: jax.Array,
+              epoch) -> Tuple[State, jax.Array]:
+        raise NotImplementedError
+
+
+class SGDUpdater(Updater):
+    kind = "sgd"
+
+    def init_state(self, w: jax.Array) -> State:
+        return {"m": jnp.zeros_like(w)}
+
+    def apply(self, state, w, grad, epoch):
+        p = self.param
+        lr, mom = p.schedule(epoch)
+        if p.clip_gradient != 0.0:
+            grad = _clip_nan(grad, p.clip_gradient)
+        m = mom * state["m"] - lr * (grad + p.wd * w)
+        return {"m": m}, w + m
+
+
+class NAGUpdater(Updater):
+    kind = "nag"
+
+    def init_state(self, w: jax.Array) -> State:
+        return {"m": jnp.zeros_like(w)}
+
+    def apply(self, state, w, grad, epoch):
+        p = self.param
+        lr, mom = p.schedule(epoch)
+        m_old = state["m"]
+        m = mom * m_old - lr * (grad + p.wd * w)
+        w = w + (1 + mom) * m - mom * m_old
+        return {"m": m}, w
+
+
+class AdamUpdater(Updater):
+    kind = "adam"
+
+    def __init__(self, param: UpdaterParam, decay1: float = 0.1,
+                 decay2: float = 0.001):
+        super().__init__(param)
+        self.decay1 = decay1
+        self.decay2 = decay2
+
+    def init_state(self, w: jax.Array) -> State:
+        return {"m1": jnp.zeros_like(w), "m2": jnp.zeros_like(w)}
+
+    def apply(self, state, w, grad, epoch):
+        p = self.param
+        epoch = jnp.asarray(epoch, dtype=jnp.float32)
+        if p.wd > 0.0:
+            grad = grad - p.wd * w  # reference sign quirk
+        fix1 = 1.0 - jnp.power(1.0 - self.decay1, epoch + 1)
+        fix2 = 1.0 - jnp.power(1.0 - self.decay2, epoch + 1)
+        lr_t = p.base_lr * jnp.sqrt(fix2) / fix1
+        m1 = state["m1"] + self.decay1 * (grad - state["m1"])
+        m2 = state["m2"] + self.decay2 * (grad * grad - state["m2"])
+        w = w - lr_t * (m1 / (jnp.sqrt(m2) + 1e-8))
+        return {"m1": m1, "m2": m2}, w
+
+
+_UPDATERS = {"sgd": SGDUpdater, "nag": NAGUpdater, "adam": AdamUpdater}
+
+
+def create_updater(kind: str, param: UpdaterParam, **kwargs) -> Updater:
+    """Factory (updater_impl-inl.hpp:18-40 CreateUpdater_)."""
+    if kind not in _UPDATERS:
+        raise ValueError(f"unknown updater type {kind}")
+    return _UPDATERS[kind](param, **kwargs)

@@ -1,0 +1,162 @@
+"""Updater numerics vs straightforward numpy simulations."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cxxnet_tpu.updater import UpdaterParam, create_updater
+
+
+def make_param(cfg, tag="wmat"):
+    p = UpdaterParam(tag)
+    for k, v in cfg:
+        p.set_param(k, v)
+    return p
+
+
+def test_sgd_momentum_steps():
+    p = make_param([("eta", "0.1"), ("momentum", "0.9"), ("wd", "0.01")])
+    up = create_updater("sgd", p)
+    w = jnp.ones((3,))
+    state = up.init_state(w)
+
+    m_ref = np.zeros(3)
+    w_ref = np.ones(3)
+    for epoch in range(3):
+        g = np.full(3, 0.5, dtype=np.float32)
+        state, w = up.apply(state, w, jnp.asarray(g), epoch)
+        m_ref = 0.9 * m_ref - 0.1 * (g + 0.01 * w_ref)
+        w_ref = w_ref + m_ref
+        np.testing.assert_allclose(np.asarray(w), w_ref, rtol=1e-5)
+
+
+def test_sgd_clip_and_nan_gradient():
+    p = make_param([("eta", "1.0"), ("momentum", "0"),
+                    ("clip_gradient", "1.0")])
+    up = create_updater("sgd", p)
+    w = jnp.zeros((3,))
+    state = up.init_state(w)
+    g = jnp.asarray([5.0, -5.0, np.nan])
+    _, w2 = up.apply(state, w, g, 0)
+    np.testing.assert_allclose(np.asarray(w2), [-1.0, 1.0, 0.0])
+
+
+def test_nag_update():
+    p = make_param([("eta", "0.1"), ("momentum", "0.9")])
+    up = create_updater("nag", p)
+    w = jnp.ones((2,))
+    state = up.init_state(w)
+    m_ref = np.zeros(2)
+    w_ref = np.ones(2)
+    for epoch in range(3):
+        g = np.full(2, 1.0, dtype=np.float32)
+        state, w = up.apply(state, w, jnp.asarray(g), epoch)
+        m_old = m_ref.copy()
+        m_ref = 0.9 * m_ref - 0.1 * g
+        w_ref = w_ref + (1 + 0.9) * m_ref - 0.9 * m_old
+        np.testing.assert_allclose(np.asarray(w), w_ref, rtol=1e-5)
+
+
+def test_adam_update():
+    p = make_param([("eta", "0.01")])
+    up = create_updater("adam", p)
+    w = jnp.ones((2,))
+    state = up.init_state(w)
+    m1 = np.zeros(2)
+    m2 = np.zeros(2)
+    w_ref = np.ones(2)
+    for epoch in range(4):
+        g = np.asarray([0.3, -0.2], dtype=np.float32)
+        state, w = up.apply(state, w, jnp.asarray(g), epoch)
+        fix1 = 1 - (1 - 0.1) ** (epoch + 1)
+        fix2 = 1 - (1 - 0.001) ** (epoch + 1)
+        lr_t = 0.01 * np.sqrt(fix2) / fix1
+        m1 = m1 + 0.1 * (g - m1)
+        m2 = m2 + 0.001 * (g * g - m2)
+        w_ref = w_ref - lr_t * (m1 / (np.sqrt(m2) + 1e-8))
+        np.testing.assert_allclose(np.asarray(w), w_ref, rtol=1e-5)
+
+
+def test_adam_wd_sign_quirk():
+    """Reference subtracts wd*w from the gradient (adam_updater:76)."""
+    p = make_param([("eta", "0.1"), ("wd", "0.1")])
+    up = create_updater("adam", p)
+    w = jnp.ones((1,))
+    state = up.init_state(w)
+    _, w_with_wd = up.apply(state, w, jnp.zeros((1,)), 0)
+    # grad = 0 - 0.1*1 = -0.1 -> m1 negative -> w increases
+    assert float(w_with_wd[0]) > 1.0
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+def test_schedule_constant_min_lr():
+    p = make_param([("eta", "1e-7")])
+    lr, _ = p.schedule(5)
+    assert float(lr) == pytest.approx(1e-5)  # clamped to lr_minimum
+
+
+def test_schedule_expdecay():
+    p = make_param([("eta", "0.1"), ("lr:schedule", "expdecay"),
+                    ("lr:gamma", "0.5"), ("lr:step", "10")])
+    lr, _ = p.schedule(20)
+    assert float(lr) == pytest.approx(0.1 * 0.5 ** 2.0, rel=1e-5)
+    lr5, _ = p.schedule(5)  # continuous exponent
+    assert float(lr5) == pytest.approx(0.1 * 0.5 ** 0.5, rel=1e-5)
+
+
+def test_schedule_polydecay():
+    p = make_param([("eta", "0.1"), ("lr:schedule", "polydecay"),
+                    ("lr:gamma", "2.0"), ("lr:alpha", "0.5"),
+                    ("lr:step", "4")])
+    lr, _ = p.schedule(9)  # steps = 2 -> (1 + 4)^-0.5
+    assert float(lr) == pytest.approx(0.1 * 5 ** -0.5, rel=1e-5)
+
+
+def test_schedule_factor_integer_division():
+    p = make_param([("eta", "1.0"), ("lr:schedule", "factor"),
+                    ("lr:factor", "0.1"), ("lr:step", "3")])
+    assert float(p.schedule(2)[0]) == pytest.approx(1.0)
+    assert float(p.schedule(3)[0]) == pytest.approx(0.1)
+    assert float(p.schedule(7)[0]) == pytest.approx(0.01)
+
+
+def test_schedule_start_epoch():
+    p = make_param([("eta", "1.0"), ("lr:schedule", "factor"),
+                    ("lr:factor", "0.1"), ("lr:step", "1"),
+                    ("lr:start_epoch", "5")])
+    assert float(p.schedule(3)[0]) == pytest.approx(1.0)  # base before start
+    assert float(p.schedule(6)[0]) == pytest.approx(1e-5)  # then scheduled
+
+
+def test_momentum_saturation():
+    p = make_param([("momentum", "0.5"), ("momentum_schedule", "1"),
+                    ("base_momentum", "0.5"), ("final_momentum", "0.99"),
+                    ("saturation_epoch", "100")])
+    _, m0 = p.schedule(0)
+    _, m50 = p.schedule(50)
+    assert float(m0) <= 0.99 + 1e-6
+    assert float(m50) == pytest.approx(0.99)  # clamped at final
+
+
+# ---------------------------------------------------------------------------
+# tag scoping
+# ---------------------------------------------------------------------------
+
+def test_tag_scoping():
+    cfg = [("lr", "0.1"), ("wmat:lr", "0.2"), ("bias:lr", "0.3"),
+           ("bias:wd", "0.7")]
+    pw = make_param(cfg, tag="wmat")
+    pb = make_param(cfg, tag="bias")
+    assert pw.base_lr == pytest.approx(0.2)
+    assert pb.base_lr == pytest.approx(0.3)
+    assert pw.wd == 0.0
+    assert pb.wd == pytest.approx(0.7)
+
+
+def test_tag_scoping_other_tags_ignored():
+    p = make_param([("lr", "0.1"), ("wmat:lr", "0.5")], tag="bias")
+    assert p.base_lr == pytest.approx(0.1)
