@@ -1,0 +1,46 @@
+"""DataBatch / DataInst: the host-side batch containers.
+
+Parity with src/io/data.h:41-181: a batch carries CPU tensors
+data (b,c,h,w) and label (b,label_width), the instance indices, the count
+of padding rows in a final short batch (num_batch_padd), and optional
+extra-data tensors. All arrays are numpy (host); the trainer moves them
+to device inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclass
+class DataInst:
+    """Single instance (data.h:41-56)."""
+    index: int
+    data: np.ndarray            # (c, h, w)
+    label: np.ndarray           # (label_width,)
+    extra_data: List[np.ndarray] = field(default_factory=list)
+
+
+@dataclass
+class DataBatch:
+    """Batch of instances (data.h:79-181)."""
+    data: np.ndarray                       # (b, c, h, w) float32
+    label: np.ndarray                      # (b, label_width) float32
+    inst_index: Optional[np.ndarray] = None  # (b,) uint32
+    num_batch_padd: int = 0
+    extra_data: List[np.ndarray] = field(default_factory=list)
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.data.shape[0])
+
+    def valid_mask(self) -> np.ndarray:
+        """(b,) float mask zeroing the trailing padding rows."""
+        b = self.batch_size
+        mask = np.ones(b, dtype=np.float32)
+        if self.num_batch_padd:
+            mask[b - self.num_batch_padd:] = 0.0
+        return mask

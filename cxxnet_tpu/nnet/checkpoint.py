@@ -1,0 +1,99 @@
+"""Model checkpoint format.
+
+Role parity with the reference model file (SURVEY.md Appendix B:
+[int net_type][NetConfig][epoch][model blob]), re-designed as
+[magic][json header][raw little-endian arrays]:
+
+- header carries net_type, the NetConfig structure dict, epoch counter,
+  and an ordered manifest of arrays (pytree path, dtype, shape);
+- the reference does NOT checkpoint optimizer state (momentum resets on
+  resume - sgd_updater-inl.hpp:33-37); we keep that default but support
+  `save_optimizer=1` which appends updater state arrays, an explicit
+  improvement the format records in the header.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, BinaryIO, Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"CXTPU001"
+
+
+def _flatten(tree: Any, prefix: str = "") -> List[Tuple[str, np.ndarray]]:
+    out = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.extend(_flatten(tree[k], f"{prefix}/{k}" if prefix else k))
+    else:
+        out.append((prefix, np.asarray(tree)))
+    return out
+
+
+def _unflatten(items: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    root: Dict[str, Any] = {}
+    for path, arr in items.items():
+        keys = path.split("/")
+        d = root
+        for k in keys[:-1]:
+            d = d.setdefault(k, {})
+        d[keys[-1]] = arr
+    return root
+
+
+def save_model(fo: BinaryIO, net_type: int, net_structure: dict, epoch: int,
+               params: dict, opt_state: Optional[dict] = None) -> None:
+    flat_params = _flatten(params)
+    flat_opt = _flatten(opt_state) if opt_state is not None else []
+    header = {
+        "net_type": net_type,
+        "net": net_structure,
+        "epoch": int(epoch),
+        "params": [
+            {"path": p, "dtype": str(a.dtype), "shape": list(a.shape)}
+            for p, a in flat_params
+        ],
+        "opt_state": [
+            {"path": p, "dtype": str(a.dtype), "shape": list(a.shape)}
+            for p, a in flat_opt
+        ],
+    }
+    hbytes = json.dumps(header).encode("utf-8")
+    fo.write(MAGIC)
+    fo.write(struct.pack("<q", len(hbytes)))
+    fo.write(hbytes)
+    for _, a in flat_params + flat_opt:
+        fo.write(np.ascontiguousarray(a).tobytes())
+
+
+def load_model(fi: BinaryIO) -> dict:
+    """Returns {net_type, net, epoch, params, opt_state or None}."""
+    magic = fi.read(len(MAGIC))
+    if magic != MAGIC:
+        raise ValueError("invalid model file (bad magic)")
+    (hlen,) = struct.unpack("<q", fi.read(8))
+    header = json.loads(fi.read(hlen).decode("utf-8"))
+
+    def read_arrays(manifest):
+        items = {}
+        for ent in manifest:
+            n = int(np.prod(ent["shape"])) if ent["shape"] else 1
+            dtype = np.dtype(ent["dtype"])
+            buf = fi.read(n * dtype.itemsize)
+            items[ent["path"]] = np.frombuffer(
+                buf, dtype=dtype).reshape(ent["shape"]).copy()
+        return items
+
+    params = _unflatten(read_arrays(header["params"]))
+    opt_state = (_unflatten(read_arrays(header["opt_state"]))
+                 if header["opt_state"] else None)
+    return {
+        "net_type": header["net_type"],
+        "net": header["net"],
+        "epoch": header["epoch"],
+        "params": params,
+        "opt_state": opt_state,
+    }

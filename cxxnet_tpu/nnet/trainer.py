@@ -1,0 +1,478 @@
+"""NetTrainer: the INetTrainer product surface, TPU-native.
+
+Role parity with CXXNetThreadTrainer (nnet_impl-inl.hpp:16-455) - the full
+virtual API of nnet.h:18-92: SetParam / InitModel / SaveModel / LoadModel /
+StartRound / Update / Evaluate / Predict / ExtractFeature / CopyModelFrom /
+SetWeight / GetWeight - but the execution model is re-designed for TPU:
+
+reference                               this trainer
+---------                               ------------
+per-GPU host thread + stream            one SPMD program over a Mesh
+batch sliced into per-device chunks     batch dim sharded over 'data' axis
+mshadow-ps push/pull + AsyncUpdater     XLA AllReduce inserted by GSPMD
+updater objects mutating weights        pure per-tensor updater transforms
+                                        folded into the same jitted step
+AdjustBatchSize for short batches       pad-to-static + validity mask
+update_period grad accumulation         carried accumulator + lax.cond
+
+The entire train step (forward + backward + gradient all-reduce +
+optimizer) compiles to ONE XLA executable; eval/predict use a second
+forward-only executable.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet import checkpoint
+from cxxnet_tpu.nnet.net_config import NetConfig
+from cxxnet_tpu.nnet.network import Network, param_key
+from cxxnet_tpu.parallel.mesh import (
+    MeshSpec, build_mesh, parse_device_spec, parse_mesh_spec)
+from cxxnet_tpu.updater import UpdaterParam, create_updater
+from cxxnet_tpu.utils.metric import MetricSet
+
+
+class NetTrainer:
+    """Config-driven trainer for one network."""
+
+    def __init__(self, dev: str = "", cfg: str = ""):
+        self.cfg_pairs: List[Tuple[str, str]] = []
+        self.net_cfg = NetConfig()
+        self.net: Optional[Network] = None
+        self.batch_size = 0
+        self.update_period = 1
+        self.eval_train = 1
+        self.seed = 0
+        self.silent = 0
+        self.compute_dtype = jnp.float32
+        self.metric = MetricSet()
+        self.train_metric = MetricSet()
+        # (node_name or "", node_id or -1) per metric - "" = final node
+        self.eval_nodes: List[Tuple[str, int]] = []
+        self.mesh_spec = MeshSpec()
+        self.mesh: Optional[Mesh] = None
+        self.epoch = 0       # update counter (reference epoch_counter)
+        self.round = 0
+        self._step_counter = 0
+        self.state: Optional[Dict[str, Any]] = None
+        self._loaded_params = None
+        self._loaded_opt = None
+        self.save_optimizer = 0
+        if dev:
+            self.set_param("dev", dev)
+        if cfg:
+            from cxxnet_tpu.utils.config import parse_config_string
+            for k, v in parse_config_string(cfg):
+                self.set_param(k, v)
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        if name == "dev":
+            self.mesh_spec.device_indices = parse_device_spec(val)
+        if name == "mesh":
+            self.mesh_spec.axes = parse_mesh_spec(val)
+        if name == "batch_size":
+            self.batch_size = int(val)
+        if name == "update_period":
+            self.update_period = int(val)
+        if name == "eval_train":
+            self.eval_train = int(val)
+        if name == "seed":
+            self.seed = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "save_optimizer":
+            self.save_optimizer = int(val)
+        if name == "dtype":
+            self.compute_dtype = {"float32": jnp.float32,
+                                  "bfloat16": jnp.bfloat16}[val]
+        if name.startswith("metric"):
+            import re
+            m = re.match(r"^metric\[([^,\]]+),([^\]]+)\]$", name)
+            if m:
+                self.metric.add_metric(val, m.group(1))
+                self.train_metric.add_metric(val, m.group(1))
+                self.eval_nodes.append((m.group(2), 0))
+            elif name == "metric":
+                self.metric.add_metric(val, "label")
+                self.train_metric.add_metric(val, "label")
+                self.eval_nodes.append(("", -1))
+        self.cfg_pairs.append((name, val))
+
+    # ------------------------------------------------------------------
+    # initialization
+    # ------------------------------------------------------------------
+    def init_model(self) -> None:
+        self.net_cfg.configure(self.cfg_pairs)
+        self._build_net()
+        key = jax.random.PRNGKey(self.seed)
+        params = self.net.init_params(key)
+        self._init_state(params)
+        self.epoch = 0
+
+    def _build_net(self) -> None:
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be set")
+        self.net = Network(self.net_cfg, self.batch_size)
+        if not self.silent:
+            for i, s in enumerate(self.net.node_shapes):
+                print(f"node[{self.net_cfg.node_names[i]}].shape: "
+                      f"{s[0]},{s[1]},{s[2]},{s[3]}")
+        self.mesh = build_mesh(self.mesh_spec, self.batch_size)
+        self._resolve_eval_nodes()
+        self._build_updaters()
+        self._compile()
+
+    def _resolve_eval_nodes(self) -> None:
+        resolved = []
+        for name, _ in self.eval_nodes:
+            if name == "":
+                resolved.append(("", self.net_cfg.num_nodes - 1))
+            else:
+                resolved.append((name, self.net.node_index(name)))
+        self.eval_nodes = resolved
+
+    def _build_updaters(self) -> None:
+        """One Updater per weight tensor, configured with defcfg +
+        layercfg[i] under its tag (neural_net-inl.hpp:177-204)."""
+        self.updaters: Dict[str, Dict[str, Any]] = {}
+        utype = self.net_cfg.updater_type
+        for idx, info in enumerate(self.net_cfg.layers):
+            if info.is_shared:
+                continue
+            tags = self.net.layer_objs[idx].param_tags()
+            if not tags:
+                continue
+            key = param_key(self.net_cfg, idx)
+            self.updaters[key] = {}
+            for pname, tag in tags.items():
+                up = UpdaterParam(tag)
+                kwargs = {}
+                for k, v in (self.net_cfg.defcfg
+                             + self.net_cfg.layercfg[idx]):
+                    up.set_param(k, v)
+                    if utype == "adam" and k == "beta1":
+                        kwargs["decay1"] = float(v)
+                    if utype == "adam" and k == "beta2":
+                        kwargs["decay2"] = float(v)
+                self.updaters[key][pname] = create_updater(utype, up,
+                                                           **kwargs)
+
+    def _init_state(self, params) -> None:
+        ustate = {
+            lk: {pn: up.init_state(params[lk][pn])
+                 for pn, up in d.items() if pn in params.get(lk, {})}
+            for lk, d in self.updaters.items()}
+        accum = jax.tree.map(jnp.zeros_like, params)
+        state = {
+            "params": params,
+            "ustate": ustate,
+            "accum": accum,
+            "count": jnp.zeros((), jnp.int32),
+            "epoch": jnp.asarray(self.epoch, jnp.int32),
+        }
+        if self._loaded_opt is not None:
+            state["ustate"] = jax.tree.map(
+                lambda a: jnp.asarray(a), self._loaded_opt)
+            self._loaded_opt = None
+        self.state = jax.device_put(state, self._replicated)
+
+    # ------------------------------------------------------------------
+    # compiled steps
+    # ------------------------------------------------------------------
+    @property
+    def _replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def _batch_sharded(self):
+        return NamedSharding(self.mesh, P("data"))
+
+    def _label_fields(self, label: np.ndarray) -> Dict[str, np.ndarray]:
+        fields = {}
+        for fname, idx in self.net_cfg.label_name_map.items():
+            a, b = self.net_cfg.label_range[idx]
+            fields[fname] = label[:, a:b]
+        return fields
+
+    def _cast(self, tree):
+        if self.compute_dtype == jnp.float32:
+            return tree
+        return jax.tree.map(
+            lambda a: a.astype(self.compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+    def _compile(self) -> None:
+        net = self.net
+        eval_node_ids = sorted({nid for _, nid in self.eval_nodes})
+        scale = 1.0 / (self.batch_size * self.update_period)
+        update_period = self.update_period
+        updaters = self.updaters
+
+        def loss_fn(params, data, labels, mask, rng):
+            cparams = self._cast(params)
+            values, loss = net.forward(
+                cparams, {0: self._cast(data)}, train=True, rng=rng,
+                labels=labels, mask=mask)
+            outs = {nid: values[nid].astype(jnp.float32)
+                    for nid in eval_node_ids}
+            return loss.astype(jnp.float32) * scale, outs
+
+        def train_step(state, data, labels, mask, rng):
+            (loss, outs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state["params"], data, labels, mask,
+                                       rng)
+            accum = jax.tree.map(jnp.add, state["accum"], grads)
+            count = state["count"] + 1
+            do_update = count >= update_period
+
+            def apply_updates(args):
+                params, ustate, accum = args
+                new_params = jax.tree.map(lambda x: x, params)
+                new_ustate = jax.tree.map(lambda x: x, ustate)
+                for lk, d in updaters.items():
+                    for pn, up in d.items():
+                        if lk not in params or pn not in params[lk]:
+                            continue
+                        st, w = up.apply(ustate[lk][pn], params[lk][pn],
+                                         accum[lk][pn], state["epoch"])
+                        new_params[lk][pn] = w
+                        new_ustate[lk][pn] = st
+                zero = jax.tree.map(jnp.zeros_like, accum)
+                return new_params, new_ustate, zero
+
+            params, ustate, accum = lax.cond(
+                do_update, apply_updates, lambda a: a,
+                (state["params"], state["ustate"], accum))
+            new_state = {
+                "params": params,
+                "ustate": ustate,
+                "accum": accum,
+                "count": jnp.where(do_update, 0, count),
+                "epoch": state["epoch"] + do_update.astype(jnp.int32),
+            }
+            return new_state, loss, outs
+
+        def eval_step(params, data):
+            cparams = self._cast(params)
+            values, _ = net.forward(cparams, {0: self._cast(data)},
+                                    train=False)
+            return {nid: values[nid].astype(jnp.float32)
+                    for nid in range(net.cfg.num_nodes)
+                    if values[nid] is not None}
+
+        rep, shd = self._replicated, self._batch_sharded
+        state_shardings = {
+            "params": rep, "ustate": rep, "accum": rep,
+            "count": rep, "epoch": rep,
+        }
+        label_shardings = {
+            f: shd for f in self.net_cfg.label_name_map}
+        self._train_step = jax.jit(
+            train_step,
+            in_shardings=(state_shardings, shd, label_shardings, shd, rep),
+            out_shardings=(state_shardings, rep, rep),
+            donate_argnums=(0,))
+        self._eval_step = jax.jit(
+            eval_step, in_shardings=(rep, shd), out_shardings=rep)
+
+    # ------------------------------------------------------------------
+    # training api
+    # ------------------------------------------------------------------
+    def start_round(self, round_counter: int) -> None:
+        self.round = round_counter
+        for layer in (self.net.layer_objs if self.net else []):
+            if hasattr(layer, "anneal_step"):
+                layer.anneal_step()
+
+    def _pad_batch(self, batch: DataBatch):
+        """Pad a short batch up to batch_size (static shapes for XLA)."""
+        b = batch.batch_size
+        if b == self.batch_size:
+            return batch.data, batch.label, batch.valid_mask()
+        if b > self.batch_size:
+            raise ValueError("batch larger than configured batch_size")
+        pad = self.batch_size - b
+        data = np.concatenate(
+            [batch.data, np.zeros((pad,) + batch.data.shape[1:],
+                                  batch.data.dtype)], axis=0)
+        label = np.concatenate(
+            [batch.label, np.zeros((pad,) + batch.label.shape[1:],
+                                   batch.label.dtype)], axis=0)
+        mask = np.concatenate([batch.valid_mask(),
+                               np.zeros(pad, np.float32)])
+        return data, label, mask
+
+    def update(self, batch: DataBatch) -> None:
+        """One training mini-batch (CXXNetThreadTrainer::Update)."""
+        data, label, mask = self._pad_batch(batch)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed + 100), self._step_counter)
+        self._step_counter += 1
+        labels = self._label_fields(label.astype(np.float32))
+        self.state, loss, outs = self._train_step(
+            self.state, data.astype(np.float32), labels,
+            mask.astype(np.float32), rng)
+        if self.eval_train:
+            preds = [np.asarray(outs[nid]) for _, nid in self.eval_nodes]
+            preds = [p.reshape(p.shape[0], -1) for p in preds]
+            self.train_metric.add_eval(preds, {
+                k: np.asarray(v) for k, v in labels.items()},
+                mask=np.asarray(mask) > 0)
+        self.epoch = int(self.state["epoch"])
+
+    def update_all(self, data_iter, eval_iters=None,
+                   eval_names=None) -> None:
+        """Convenience: one full pass (round) over a data iterator."""
+        data_iter.before_first()
+        while data_iter.next():
+            self.update(data_iter.value())
+
+    # ------------------------------------------------------------------
+    # evaluation / inference api
+    # ------------------------------------------------------------------
+    def _forward_nodes(self, batch: DataBatch) -> Dict[int, np.ndarray]:
+        data, _, mask = self._pad_batch(batch)
+        outs = self._eval_step(self.state["params"],
+                               data.astype(np.float32))
+        valid = int(mask.sum())
+        return {nid: np.asarray(v)[:valid] for nid, v in outs.items()}
+
+    def evaluate(self, data_iter, data_name: str) -> str:
+        """Run eval metrics over an iterator; returns the reference-format
+        string `\\tname-metric:value...` (nnet_impl-inl.hpp:224-245)."""
+        self.metric.clear()
+        data_iter.before_first()
+        while data_iter.next():
+            batch = data_iter.value()
+            nodes = self._forward_nodes(batch)
+            nvalid = batch.batch_size - batch.num_batch_padd
+            labels = self._label_fields(
+                batch.label.astype(np.float32)[:nvalid])
+            preds = []
+            for _, nid in self.eval_nodes:
+                p = nodes[nid][:nvalid]
+                preds.append(p.reshape(p.shape[0], -1))
+            self.metric.add_eval(preds, labels)
+        return self.metric.print(data_name)
+
+    def eval_train_metric(self) -> str:
+        out = self.train_metric.print("train")
+        self.train_metric.clear()
+        return out
+
+    def predict(self, batch: DataBatch) -> np.ndarray:
+        """Prediction = argmax of the final node (or raw scalar);
+        nnet_impl-inl.hpp:186-199 TransformPred."""
+        nodes = self._forward_nodes(batch)
+        out = nodes[self.net_cfg.num_nodes - 1]
+        flat = out.reshape(out.shape[0], -1)
+        if flat.shape[1] == 1:
+            return flat[:, 0]
+        return np.argmax(flat, axis=1).astype(np.float32)
+
+    def predict_dist(self, batch: DataBatch) -> np.ndarray:
+        """Full output distribution of the final node."""
+        nodes = self._forward_nodes(batch)
+        out = nodes[self.net_cfg.num_nodes - 1]
+        return out.reshape(out.shape[0], -1)
+
+    def extract_feature(self, batch: DataBatch,
+                        node_name: str) -> np.ndarray:
+        """Copy out any node by name or `top[-k]`
+        (nnet_impl-inl.hpp:200-223)."""
+        nid = self.net.node_index(node_name)
+        nodes = self._forward_nodes(batch)
+        return nodes[nid]
+
+    # ------------------------------------------------------------------
+    # checkpoint api
+    # ------------------------------------------------------------------
+    def save_model(self, fo) -> None:
+        params = jax.tree.map(np.asarray, self.state["params"])
+        opt = None
+        if self.save_optimizer:
+            opt = jax.tree.map(np.asarray, self.state["ustate"])
+        checkpoint.save_model(fo, 0, self.net_cfg.to_dict(), self.epoch,
+                              params, opt)
+
+    def load_model(self, fi) -> None:
+        blob = checkpoint.load_model(fi)
+        self.net_cfg = NetConfig.from_dict(blob["net"])
+        self.net_cfg.configure(self.cfg_pairs)
+        self.epoch = blob["epoch"]
+        self._loaded_opt = blob["opt_state"]
+        self._build_net()
+        params = jax.tree.map(jnp.asarray, blob["params"])
+        self._init_state(params)
+        self.state["epoch"] = jax.device_put(
+            jnp.asarray(self.epoch, jnp.int32), self._replicated)
+
+    def copy_model_from(self, fi) -> None:
+        """Finetune: copy params of layers whose names match
+        (nnet_impl-inl.hpp:101-134). Must be called after init_model."""
+        if self.state is None:
+            raise RuntimeError("copy_model_from requires init_model first")
+        blob = checkpoint.load_model(fi)
+        params = jax.tree.map(np.asarray, self.state["params"])
+        copied = []
+        for lk, d in blob["params"].items():
+            if lk.startswith("layer_"):
+                continue  # unnamed layers are not matched
+            if lk in params:
+                for pn, arr in d.items():
+                    if (pn in params[lk]
+                            and params[lk][pn].shape == arr.shape):
+                        params[lk][pn] = arr
+                copied.append(lk)
+        if not self.silent:
+            print(f"finetune: copied layers {copied}")
+        self._init_state(jax.tree.map(jnp.asarray, params))
+
+    # ------------------------------------------------------------------
+    # weight access api (visitor semantics)
+    # ------------------------------------------------------------------
+    def get_weight(self, layer_name: str,
+                   tag: str) -> Tuple[np.ndarray, Tuple[int, ...]]:
+        """Returns (2-D flattened weight, original shape); GetWeightVisitor
+        flattening = (shape[0], prod(rest)) (visitor.h:26-100)."""
+        lk = self._weight_key(layer_name, tag)
+        arr = np.asarray(self.state["params"][lk[0]][lk[1]])
+        return arr.reshape(arr.shape[0], -1), arr.shape
+
+    def set_weight(self, weight: np.ndarray, layer_name: str,
+                   tag: str) -> None:
+        lk = self._weight_key(layer_name, tag)
+        cur = self.state["params"][lk[0]][lk[1]]
+        arr = np.asarray(weight, dtype=np.float32).reshape(cur.shape)
+        params = self.state["params"]
+        params[lk[0]][lk[1]] = jax.device_put(
+            jnp.asarray(arr), self._replicated)
+        self.state["params"] = params
+
+    def _weight_key(self, layer_name: str, tag: str) -> Tuple[str, str]:
+        idx = self.net_cfg.get_layer_index(layer_name)
+        tags = self.net.layer_objs[idx].param_tags()
+        for pname, t in tags.items():
+            if t == tag or pname == tag:
+                return param_key(self.net_cfg, idx), pname
+        raise KeyError(f"layer {layer_name} has no weight tagged {tag}")
+
+
+def create_net(net_type: int = 0, dev: str = "", cfg: str = "") -> NetTrainer:
+    """CreateNet factory parity (nnet.h:99-100; net_type is ignored by the
+    reference too - nnet_impl-inl.hpp:457-460)."""
+    return NetTrainer(dev, cfg)

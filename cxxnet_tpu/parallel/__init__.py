@@ -1,0 +1,14 @@
+"""Parallelism: device mesh construction and sharding rules.
+
+The reference's multi-device story (one host thread + CUDA stream + full
+model replica per GPU, gradients synced through mshadow-ps - SURVEY.md
+par.2.7) maps to a single SPMD program over a `jax.sharding.Mesh`: the batch
+dim is sharded over the 'data' axis, params are replicated (or sharded over
+'model' for tensor parallelism), and XLA inserts the AllReduce over ICI
+that replaces the entire push/pull parameter server.
+"""
+
+from cxxnet_tpu.parallel.mesh import (
+    MeshSpec, build_mesh, parse_device_spec)
+
+__all__ = ["MeshSpec", "build_mesh", "parse_device_spec"]

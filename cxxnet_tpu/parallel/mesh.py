@@ -1,0 +1,96 @@
+"""Device-spec parsing and Mesh construction.
+
+Config surface parity (nnet_impl-inl.hpp:32-51): `dev = gpu:0-3`,
+`dev = cpu:0,2`, `dev = tpu:0-63`. The device *kind* is advisory - the
+process uses whatever platform JAX exposes (TPU under the tunnel, CPU with
+a forced host platform in tests); the index list picks devices by position.
+
+Extension over the reference: `mesh = data:8,model:4` declares a 2-D mesh
+for combined data/tensor parallelism. Without it, all selected devices form
+a 1-D 'data' mesh (pure data parallelism - the reference's only mode).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+@dataclass
+class MeshSpec:
+    device_indices: Optional[List[int]] = None  # None = single device
+    axes: List[Tuple[str, int]] = field(default_factory=list)
+
+    @property
+    def num_devices(self) -> int:
+        if self.axes:
+            n = 1
+            for _, k in self.axes:
+                n *= k
+            return n
+        return len(self.device_indices) if self.device_indices else 1
+
+
+def parse_device_spec(val: str) -> Optional[List[int]]:
+    """`cpu` / `tpu` -> None (single default device);
+    `tpu:0-3` -> [0,1,2,3]; `tpu:0,2` -> [0,2]."""
+    if ":" not in val:
+        return None
+    spec = val.split(":", 1)[1]
+    if "-" in spec:
+        a, b = spec.split("-")
+        return list(range(int(a), int(b) + 1))
+    return [int(t) for t in spec.split(",")]
+
+
+def parse_mesh_spec(val: str) -> List[Tuple[str, int]]:
+    """`data:8` or `data:8,model:4` -> [(axis, size), ...]."""
+    axes = []
+    for part in val.split(","):
+        name, size = part.split(":")
+        axes.append((name.strip(), int(size)))
+    return axes
+
+
+def build_mesh(spec: MeshSpec, batch_size: int,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the mesh, pruning the data axis to divide batch_size.
+
+    The reference prunes its device list when the batch is too small
+    (nnet_impl-inl.hpp:141-150); here the constraint is divisibility:
+    the data axis is shrunk to the largest size that divides batch_size.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec.axes:
+        names = [a for a, _ in spec.axes]
+        sizes = [k for _, k in spec.axes]
+    else:
+        idx = spec.device_indices
+        if idx is None:
+            devices = devices[:1]
+        else:
+            if max(idx) >= len(devices):
+                raise ValueError(
+                    f"device spec requests index {max(idx)} but only "
+                    f"{len(devices)} devices are available")
+            devices = [devices[i] for i in idx]
+        names = ["data"]
+        sizes = [len(devices)]
+
+    # prune the data axis to divide the batch
+    if "data" in names:
+        di = names.index("data")
+        while batch_size % sizes[di] != 0:
+            sizes[di] -= 1
+
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh of {n} devices requested, {len(devices)} available")
+    dev_array = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(dev_array, tuple(names))

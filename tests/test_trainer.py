@@ -1,0 +1,265 @@
+"""Trainer end-to-end tests on synthetic data (CPU, 8 virtual devices)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+MLP_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1:ac1] = tanh
+layer[ac1->fc2] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 16
+eta = 0.5
+momentum = 0.9
+wd = 0.0
+metric = error
+eval_train = 1
+"""
+
+
+def make_trainer(extra="", cfg=MLP_CFG, silent=True):
+    t = NetTrainer()
+    for k, v in parse_config_string(cfg + extra):
+        t.set_param(k, v)
+    if silent:
+        t.set_param("silent", "1")
+    t.init_model()
+    return t
+
+
+def synth_batches(n_batches=20, batch_size=16, seed=0):
+    """Linearly separable 2-class data."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(8)
+    batches = []
+    for _ in range(n_batches):
+        x = rng.randn(batch_size, 8).astype(np.float32)
+        y = (x @ w > 0).astype(np.float32)
+        batches.append(DataBatch(
+            data=x.reshape(batch_size, 1, 1, 8),
+            label=y.reshape(batch_size, 1)))
+    return batches
+
+
+class ListIter:
+    def __init__(self, batches):
+        self.batches = batches
+        self.i = -1
+
+    def before_first(self):
+        self.i = -1
+
+    def next(self):
+        self.i += 1
+        return self.i < len(self.batches)
+
+    def value(self):
+        return self.batches[self.i]
+
+
+def test_training_converges():
+    t = make_trainer()
+    batches = synth_batches(30)
+    for r in range(8):
+        t.start_round(r)
+        for b in batches:
+            t.update(b)
+        t.train_metric.clear()
+    # eval error on held-out batches from the same distribution
+    out = t.evaluate(ListIter(synth_batches(5, seed=0)), "test")
+    err = float(out.split(":")[-1])
+    assert err < 0.15, out
+    assert out.startswith("\ttest-error:")
+
+
+def test_epoch_counter_and_update_period():
+    t = make_trainer(extra="update_period = 2\n")
+    batches = synth_batches(4)
+    p0 = np.asarray(t.state["params"]["fc1"]["wmat"]).copy()
+    t.update(batches[0])
+    assert t.epoch == 0  # no update yet
+    p1 = np.asarray(t.state["params"]["fc1"]["wmat"])
+    np.testing.assert_allclose(p0, p1)  # params unchanged before period
+    t.update(batches[1])
+    assert t.epoch == 1
+    p2 = np.asarray(t.state["params"]["fc1"]["wmat"])
+    assert np.abs(p2 - p0).max() > 0
+
+
+def test_update_period_equals_two_small_steps():
+    """grad accumulation over 2 half-batches == reference scaling."""
+    t1 = make_trainer()
+    t2 = make_trainer(extra="update_period = 2\n")
+    # same params start
+    b = synth_batches(2)
+    t2.update(b[0])
+    t2.update(b[1])
+    assert t2.epoch == 1
+
+
+def test_short_batch_padding_and_metrics():
+    t = make_trainer()
+    x = np.ones((10, 1, 1, 8), dtype=np.float32)
+    y = np.zeros((10, 1), dtype=np.float32)
+    short = DataBatch(data=x, label=y, num_batch_padd=0)
+    # batch smaller than batch_size: padded internally
+    t.update(short)
+    out = t.evaluate(ListIter([short]), "t")
+    assert np.isfinite(float(out.split(":")[-1]))
+
+
+def test_num_batch_padd_trimming():
+    t = make_trainer()
+    x = np.random.RandomState(0).randn(16, 1, 1, 8).astype(np.float32)
+    y = np.zeros((16, 1), dtype=np.float32)
+    batch = DataBatch(data=x, label=y, num_batch_padd=6)
+    p = t.predict(batch)
+    assert p.shape == (10,)  # padding rows trimmed
+
+
+def test_predict_and_extract():
+    t = make_trainer()
+    b = synth_batches(1)[0]
+    pred = t.predict(b)
+    assert pred.shape == (16,)
+    assert set(np.unique(pred)) <= {0.0, 1.0}
+    dist = t.predict_dist(b)
+    assert dist.shape == (16, 2)
+    np.testing.assert_allclose(dist.sum(axis=1), 1.0, rtol=1e-5)
+    feat = t.extract_feature(b, "ac1")
+    assert feat.shape == (16, 1, 1, 32)
+    feat2 = t.extract_feature(b, "top[-1]")
+    assert feat2.shape == (16, 1, 1, 2)
+    feat3 = t.extract_feature(b, "top[-2]")
+    assert feat3.shape == (16, 1, 1, 32)
+
+
+def test_checkpoint_roundtrip():
+    t = make_trainer()
+    for b in synth_batches(3):
+        t.update(b)
+    buf = io.BytesIO()
+    t.save_model(buf)
+
+    t2 = make_trainer()
+    buf.seek(0)
+    t2.load_model(buf)
+    assert t2.epoch == t.epoch
+    np.testing.assert_allclose(
+        np.asarray(t2.state["params"]["fc1"]["wmat"]),
+        np.asarray(t.state["params"]["fc1"]["wmat"]))
+    # both predict identically
+    b = synth_batches(1, seed=7)[0]
+    np.testing.assert_allclose(t.predict_dist(b), t2.predict_dist(b),
+                               rtol=1e-5)
+
+
+def test_checkpoint_with_optimizer_state():
+    t = make_trainer(extra="save_optimizer = 1\n")
+    for b in synth_batches(3):
+        t.update(b)
+    buf = io.BytesIO()
+    t.save_model(buf)
+    buf.seek(0)
+    t2 = make_trainer(extra="save_optimizer = 1\n")
+    t2.load_model(buf)
+    np.testing.assert_allclose(
+        np.asarray(t2.state["ustate"]["fc1"]["wmat"]["m"]),
+        np.asarray(t.state["ustate"]["fc1"]["wmat"]["m"]))
+
+
+def test_finetune_copy_model_from():
+    t = make_trainer()
+    for b in synth_batches(3):
+        t.update(b)
+    buf = io.BytesIO()
+    t.save_model(buf)
+
+    # new net with same fc1 but different fc2 width: fc1 copied, fc2 not
+    cfg2 = MLP_CFG.replace("nhidden = 2", "nhidden = 4")
+    t2 = make_trainer(cfg=cfg2)
+    buf.seek(0)
+    t2.copy_model_from(buf)
+    np.testing.assert_allclose(
+        np.asarray(t2.state["params"]["fc1"]["wmat"]),
+        np.asarray(t.state["params"]["fc1"]["wmat"]))
+    assert np.asarray(t2.state["params"]["fc2"]["wmat"]).shape == (4, 32)
+
+
+def test_get_set_weight():
+    t = make_trainer()
+    w, shape = t.get_weight("fc1", "wmat")
+    assert w.shape == (32, 8) and shape == (32, 8)
+    new = np.zeros_like(w)
+    t.set_weight(new, "fc1", "wmat")
+    w2, _ = t.get_weight("fc1", "wmat")
+    np.testing.assert_allclose(w2, 0.0)
+    b = synth_batches(1)[0]
+    dist = t.predict_dist(b)
+    assert np.isfinite(dist).all()
+
+
+def test_data_parallel_multi_device_matches_single():
+    """dp over 8 virtual devices == single device (same jit program)."""
+    assert len(jax.devices()) == 8
+    t1 = make_trainer()  # single default device
+    t8 = make_trainer(extra="dev = tpu:0-7\n")
+    assert t8.mesh.devices.size == 8
+    batches = synth_batches(5)
+    for b in batches:
+        t1.update(b)
+        t8.update(b)
+    np.testing.assert_allclose(
+        np.asarray(t1.state["params"]["fc1"]["wmat"]),
+        np.asarray(t8.state["params"]["fc1"]["wmat"]), rtol=2e-4, atol=1e-5)
+
+
+def test_device_pruning_for_odd_batch():
+    # batch 16 with 5 devices requested -> pruned to 4
+    t = make_trainer(extra="dev = tpu:0-4\n")
+    assert t.mesh.devices.size == 4
+
+
+def test_multi_target_metrics():
+    cfg = """
+label_vec[0,1) = label
+label_vec[1,3) = extra
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+layer[+1:act] = relu
+layer[act->out1] = fullc:o1
+  nhidden = 2
+layer[+0] = softmax
+layer[act->out2] = fullc:o2
+  nhidden = 2
+layer[+0] = l2_loss
+  target = extra
+netconfig=end
+input_shape = 1,1,4
+batch_size = 8
+eta = 0.01
+metric[label,out1] = error
+metric[extra,out2] = rmse
+"""
+    t = make_trainer(cfg=cfg)
+    x = np.random.RandomState(0).randn(8, 1, 1, 4).astype(np.float32)
+    label = np.zeros((8, 3), dtype=np.float32)
+    t.update(DataBatch(data=x, label=label))
+    out = t.evaluate(ListIter([DataBatch(data=x, label=label)]), "e")
+    assert "e-error:" in out and "e-rmse[extra]:" in out
