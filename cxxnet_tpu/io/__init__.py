@@ -1,0 +1,57 @@
+"""Data pipeline: composable iterators driven by config blocks.
+
+Factory parity with src/io/data.cpp:23-74: `iter = <name>` lines build the
+chain (base instance iterators are wrapped in augment + batch adapters);
+params following an `iter =` line are applied to the whole current chain.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from cxxnet_tpu.io.data import DataBatch, DataInst
+from cxxnet_tpu.io.iterators import DataIter
+
+
+def create_iterator(cfg: List[Tuple[str, str]]) -> DataIter:
+    from cxxnet_tpu.io.augment import AugmentIterator
+    from cxxnet_tpu.io.iter_batch import (BatchAdaptIterator,
+                                          ThreadBufferIterator)
+    from cxxnet_tpu.io.iter_extra import (AttachTxtIterator,
+                                          DenseBufferIterator)
+    from cxxnet_tpu.io.iter_img import ImageBinIterator, ImageIterator
+    from cxxnet_tpu.io.iter_mnist import MNISTIterator
+
+    it: DataIter = None
+    for name, val in cfg:
+        if name == "iter":
+            if val == "mnist":
+                assert it is None, "mnist cannot chain over other iterators"
+                it = MNISTIterator()
+            elif val in ("imgbin", "imgbinx"):
+                assert it is None, "imgbin cannot chain over other iterators"
+                it = BatchAdaptIterator(
+                    AugmentIterator(ImageBinIterator()))
+            elif val == "img":
+                assert it is None, "img cannot chain over other iterators"
+                it = BatchAdaptIterator(AugmentIterator(ImageIterator()))
+            elif val == "threadbuffer":
+                assert it is not None, "must specify input of threadbuffer"
+                it = ThreadBufferIterator(it)
+            elif val == "membuffer":
+                assert it is not None, "must specify input of membuffer"
+                it = DenseBufferIterator(it)
+            elif val == "attachtxt":
+                assert it is not None, "must specify input of attachtxt"
+                it = AttachTxtIterator(it)
+            elif val == "end":
+                break
+            else:
+                raise ValueError(f"unknown iterator type {val}")
+        elif it is not None:
+            it.set_param(name, val)
+    assert it is not None, "must specify iterator by iter=itername"
+    return it
+
+
+__all__ = ["DataBatch", "DataInst", "DataIter", "create_iterator"]

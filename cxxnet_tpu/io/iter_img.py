@@ -1,0 +1,242 @@
+"""Image-list iterators.
+
+- ImageIterator (`img`): .lst file + loose image files
+  (src/io/iter_img-inl.hpp:16-137).
+- ImageBinIterator (`imgbin`/`imgbinx`): .lst + packed BinaryPage .bin
+  with background page prefetch (src/io/iter_thread_imbin-inl.hpp and
+  iter_thread_imbin_x-inl.hpp roles merged: page-level prefetch thread +
+  in-memory JPEG decode, instance-level shuffle, multi-bin template
+  support, per-worker sharding for distributed runs).
+
+.lst line format: `index \\t label... \\t filename`.
+Images decode to RGB (c,h,w) float arrays in [0,255].
+"""
+
+from __future__ import annotations
+
+import io as _io
+import queue
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from cxxnet_tpu.io.data import DataInst
+from cxxnet_tpu.io.iterators import DataIter
+from cxxnet_tpu.utils.binary_page import BinaryPage, K_PAGE_SIZE
+
+
+def decode_image(blob: bytes) -> np.ndarray:
+    """JPEG/PNG bytes -> (c, h, w) float32 RGB in [0,255]."""
+    from PIL import Image
+    img = Image.open(_io.BytesIO(blob))
+    img = img.convert("RGB")
+    arr = np.asarray(img, dtype=np.float32)  # (h, w, 3)
+    return np.ascontiguousarray(arr.transpose(2, 0, 1))
+
+
+def load_image_file(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        return decode_image(f.read())
+
+
+def parse_list_file(path: str) -> List[Tuple[int, List[float], str]]:
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip("\n\r")
+            if not line:
+                continue
+            parts = line.split("\t")
+            idx = int(float(parts[0]))
+            labels = [float(t) for t in parts[1:-1]]
+            out.append((idx, labels, parts[-1]))
+    return out
+
+
+class ImageIterator(DataIter):
+    """`img`: loose image files listed in a .lst."""
+
+    K_RAND_MAGIC = 111
+
+    def __init__(self) -> None:
+        self.path_imglist = ""
+        self.path_root = ""
+        self.shuffle = 0
+        self.silent = 0
+        self.label_width = 1
+        self.rng = np.random.RandomState(self.K_RAND_MAGIC)
+        self.order: List[int] = []
+        self.loc = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "image_list":
+            self.path_imglist = val
+        if name == "image_root":
+            self.path_root = val
+        if name == "shuffle":
+            self.shuffle = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "label_width":
+            self.label_width = int(val)
+        if name == "seed_data":
+            self.rng = np.random.RandomState(self.K_RAND_MAGIC + int(val))
+
+    def init(self) -> None:
+        self.entries = parse_list_file(self.path_imglist)
+        self.order = list(range(len(self.entries)))
+        if not self.silent:
+            print(f"ImageIterator: {self.path_imglist}, "
+                  f"{len(self.entries)} images")
+        self.before_first()
+
+    def before_first(self) -> None:
+        if self.shuffle:
+            self.rng.shuffle(self.order)
+        self.loc = 0
+
+    def next(self) -> bool:
+        if self.loc >= len(self.order):
+            return False
+        idx, labels, fname = self.entries[self.order[self.loc]]
+        self.loc += 1
+        data = load_image_file(self.path_root + fname)
+        label = np.asarray(labels[:self.label_width], dtype=np.float32)
+        self._out = DataInst(index=idx, data=data, label=label)
+        return True
+
+    def value(self) -> DataInst:
+        return self._out
+
+
+class _PageReader(threading.Thread):
+    """Background thread streaming BinaryPages from .bin files."""
+
+    def __init__(self, paths: List[str], out_q: "queue.Queue"):
+        super().__init__(daemon=True)
+        self.paths = paths
+        self.out_q = out_q
+
+    def run(self) -> None:
+        try:
+            for path in self.paths:
+                with open(path, "rb") as f:
+                    while True:
+                        page = BinaryPage.load(f)
+                        if page is None:
+                            break
+                        self.out_q.put(page)
+        finally:
+            self.out_q.put(None)  # sentinel
+
+
+class ImageBinIterator(DataIter):
+    """`imgbin` / `imgbinx`: .lst + BinaryPage-packed image blobs.
+
+    The reference's two iterators differ in pipelining depth; here one
+    implementation covers both config names: a prefetch thread loads 64MiB
+    pages ahead of decode (ThreadBuffer role), instances optionally
+    shuffle inside a page (imgbinx shuffle_), and `image_conf_prefix` /
+    `image_conf_ids` template multi-file datasets with round-robin
+    sharding across distributed workers
+    (iter_thread_imbin-inl.hpp:189-220).
+    """
+
+    K_RAND_MAGIC = 222
+
+    def __init__(self) -> None:
+        self.path_imglist = ""
+        self.path_imgbin: List[str] = []
+        self.conf_prefix = ""
+        self.conf_ids = ""
+        self.shuffle = 0
+        self.silent = 0
+        self.label_width = 1
+        self.dist_num_worker = 1
+        self.dist_worker_rank = 0
+        self.rng = np.random.RandomState(self.K_RAND_MAGIC)
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "image_list":
+            self.path_imglist = val
+        if name == "image_bin":
+            self.path_imgbin = [val]
+        if name == "image_conf_prefix":
+            self.conf_prefix = val
+        if name == "image_conf_ids":
+            self.conf_ids = val
+        if name == "shuffle":
+            self.shuffle = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "label_width":
+            self.label_width = int(val)
+        if name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        if name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
+        if name == "seed_data":
+            self.rng = np.random.RandomState(self.K_RAND_MAGIC + int(val))
+
+    def _expand_templates(self) -> Tuple[List[str], List[str]]:
+        """image_conf_prefix with %d + image_conf_ids `a-b` -> shard lists
+        round-robin over workers (reference :189-220)."""
+        if not self.conf_prefix:
+            return [self.path_imglist], list(self.path_imgbin)
+        a, b = (int(t) for t in self.conf_ids.split("-"))
+        ids = [i for i in range(a, b + 1)]
+        mine = [i for k, i in enumerate(ids)
+                if k % self.dist_num_worker == self.dist_worker_rank]
+        lists = [(self.conf_prefix % i) + ".lst" for i in mine]
+        bins = [(self.conf_prefix % i) + ".bin" for i in mine]
+        return lists, bins
+
+    def init(self) -> None:
+        lists, bins = self._expand_templates()
+        self.entries = []
+        for lst in lists:
+            self.entries.extend(parse_list_file(lst))
+        self.bins = bins
+        if not self.silent:
+            print(f"ImageBinIterator: {len(self.entries)} images from "
+                  f"{len(bins)} bins")
+        self.before_first()
+
+    def before_first(self) -> None:
+        self._q: "queue.Queue" = queue.Queue(maxsize=4)
+        self._reader = _PageReader(self.bins, self._q)
+        self._reader.start()
+        self._page_objs: List[bytes] = []
+        self._page_order: List[int] = []
+        self._page_pos = 0
+        self._entry_pos = 0
+
+    def _next_page(self) -> bool:
+        page = self._q.get()
+        if page is None:
+            return False
+        self._page_objs = [page[i] for i in range(page.size)]
+        self._page_order = list(range(len(self._page_objs)))
+        if self.shuffle:
+            self.rng.shuffle(self._page_order)
+        self._page_pos = 0
+        return True
+
+    def next(self) -> bool:
+        while self._page_pos >= len(self._page_objs):
+            if not self._next_page():
+                return False
+        blob = self._page_objs[self._page_order[self._page_pos]]
+        ent_idx = self._entry_pos + self._page_order[self._page_pos]
+        self._page_pos += 1
+        if self._page_pos >= len(self._page_objs):
+            self._entry_pos += len(self._page_objs)
+        idx, labels, _ = self.entries[ent_idx]
+        data = decode_image(blob)
+        label = np.asarray(labels[:self.label_width], dtype=np.float32)
+        self._out = DataInst(index=idx, data=data, label=label)
+        return True
+
+    def value(self) -> DataInst:
+        return self._out
