@@ -297,11 +297,10 @@ class AugmentIterator(DataIter):
             acc += x
             cnt += 1
         mean = (acc / max(cnt, 1)).astype(np.float32)
-        np.save(self.name_meanimg if self.name_meanimg.endswith(".npy")
-                else self.name_meanimg, mean)
-        # np.save appends .npy when missing; normalize the name
-        if not self.name_meanimg.endswith(".npy") and not os.path.exists(
-                self.name_meanimg):
+        # np.save appends .npy to extension-less names; keep the exact
+        # configured filename so the cache-lookup in init() finds it
+        np.save(self.name_meanimg, mean)
+        if not os.path.exists(self.name_meanimg):
             os.rename(self.name_meanimg + ".npy", self.name_meanimg)
         self.meanimg = mean
         self.base.before_first()

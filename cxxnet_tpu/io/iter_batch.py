@@ -138,12 +138,23 @@ class ThreadBufferIterator(DataIter):
             print(f"ThreadBufferIterator: buffer_size={self.buffer_size}")
 
     def _producer(self, q: "queue.Queue", stop: threading.Event) -> None:
+        def put(item) -> bool:
+            # bounded put that aborts on stop so shutdown can't deadlock
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         try:
             self.base.before_first()
             while not stop.is_set() and self.base.next():
-                q.put(self.base.value())
+                if not put(self.base.value()):
+                    return
         finally:
-            q.put(None)
+            put(None)
 
     def before_first(self) -> None:
         self._shutdown()
@@ -156,13 +167,14 @@ class ThreadBufferIterator(DataIter):
     def _shutdown(self) -> None:
         if self._thread is not None:
             self._stop.set()
-            # drain so the producer can exit its q.put
-            try:
-                while True:
-                    self._q.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=5.0)
+            while self._thread.is_alive():
+                # drain so any pending put unblocks, then wait
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                self._thread.join(timeout=0.1)
             self._thread = None
 
     def next(self) -> bool:

@@ -40,6 +40,8 @@ class DenseBufferIterator(DataIter):
     def before_first(self) -> None:
         self._pos = 0
         if not self._filled:
+            # restarting mid-fill: refill from scratch to avoid duplicates
+            self._cache = []
             self.base.before_first()
 
     def next(self) -> bool:

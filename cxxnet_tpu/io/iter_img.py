@@ -23,7 +23,7 @@ import numpy as np
 
 from cxxnet_tpu.io.data import DataInst
 from cxxnet_tpu.io.iterators import DataIter
-from cxxnet_tpu.utils.binary_page import BinaryPage, K_PAGE_SIZE
+from cxxnet_tpu.utils.binary_page import iter_page_blobs
 
 
 def decode_image(blob: bytes) -> np.ndarray:
@@ -111,24 +111,34 @@ class ImageIterator(DataIter):
 
 
 class _PageReader(threading.Thread):
-    """Background thread streaming BinaryPages from .bin files."""
+    """Background thread streaming page blob-lists from .bin files."""
 
-    def __init__(self, paths: List[str], out_q: "queue.Queue"):
+    def __init__(self, paths: List[str], out_q: "queue.Queue",
+                 stop: threading.Event):
         super().__init__(daemon=True)
         self.paths = paths
         self.out_q = out_q
+        self.stop_event = stop
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when asked to stop."""
+        while not self.stop_event.is_set():
+            try:
+                self.out_q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def run(self) -> None:
         try:
             for path in self.paths:
                 with open(path, "rb") as f:
-                    while True:
-                        page = BinaryPage.load(f)
-                        if page is None:
-                            break
-                        self.out_q.put(page)
+                    for blobs in iter_page_blobs(f):
+                        if not self._put(blobs):
+                            return
         finally:
-            self.out_q.put(None)  # sentinel
+            self._put(None)  # sentinel
 
 
 class ImageBinIterator(DataIter):
@@ -204,19 +214,35 @@ class ImageBinIterator(DataIter):
         self.before_first()
 
     def before_first(self) -> None:
+        self._shutdown_reader()
+        self._stop = threading.Event()
         self._q: "queue.Queue" = queue.Queue(maxsize=4)
-        self._reader = _PageReader(self.bins, self._q)
+        self._reader = _PageReader(self.bins, self._q, self._stop)
         self._reader.start()
         self._page_objs: List[bytes] = []
         self._page_order: List[int] = []
         self._page_pos = 0
         self._entry_pos = 0
 
+    def _shutdown_reader(self) -> None:
+        reader = getattr(self, "_reader", None)
+        if reader is None or not reader.is_alive():
+            return
+        self._stop.set()
+        while reader.is_alive():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            reader.join(timeout=0.1)
+        self._reader = None
+
     def _next_page(self) -> bool:
-        page = self._q.get()
-        if page is None:
+        blobs = self._q.get()
+        if blobs is None:
             return False
-        self._page_objs = [page[i] for i in range(page.size)]
+        self._page_objs = blobs
         self._page_order = list(range(len(self._page_objs)))
         if self.shuffle:
             self.rng.shuffle(self._page_order)

@@ -1,0 +1,329 @@
+"""CLI task driver.
+
+Behavior parity with CXXNetLearnTask (src/cxxnet_main.cpp:16-478):
+
+    python -m cxxnet_tpu.main <config.conf> [k=v ...]
+
+- tasks: train (default) / finetune / pred / extract
+- `continue = 1` resumes from the newest `model_dir/%04d.model`
+- per-round checkpoints gated by `save_model` period
+- eval metrics printed per round to stderr as
+  `[round]\\ttrain-metric:x\\tevalname-metric:y`
+- `test_io = 1` drives the full data pipeline with Update skipped
+- `pred = file` + task=pred writes one prediction per line;
+  task=extract with `extract_node_name` dumps features (+ .meta)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_file
+
+
+class LearnTask:
+    def __init__(self) -> None:
+        self.task = "train"
+        self.net_type = 0
+        self.net_trainer: Optional[NetTrainer] = None
+        self.itr_train = None
+        self.itr_pred = None
+        self.itr_evals = []
+        self.eval_names: List[str] = []
+        self.name_model_dir = "models"
+        self.num_round = 10
+        self.test_io = 0
+        self.silent = 0
+        self.start_counter = 0
+        self.max_round = 1 << 31
+        self.continue_training = 0
+        self.save_period = 1
+        self.name_model_in = "NULL"
+        self.name_pred = "pred.txt"
+        self.print_step = 100
+        self.extract_node_name = ""
+        self.output_format = 1
+        self.device = "tpu"
+        self.eval_train = 1
+        self.cfg: List[Tuple[str, str]] = []
+
+    # ------------------------------------------------------------------
+    def run(self, argv: List[str]) -> int:
+        if len(argv) < 1:
+            print("Usage: <config> [k=v ...]")
+            return 0
+        for name, val in parse_config_file(argv[0]):
+            self.set_param(name, val)
+        for arg in argv[1:]:
+            if "=" in arg:
+                name, val = arg.split("=", 1)
+                self.set_param(name.strip(), val.strip())
+        self.init()
+        if not self.silent:
+            print("initializing end, start working")
+        if self.task in ("train", "finetune"):
+            self.task_train()
+        elif self.task == "pred":
+            self.task_predict()
+        elif self.task == "extract":
+            self.task_extract_feature()
+        else:
+            raise ValueError(f"unknown task {self.task}")
+        return 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if val == "default":
+            return
+        if name == "net_type":
+            self.net_type = int(val)
+        if name == "print_step":
+            self.print_step = int(val)
+        if name == "continue":
+            self.continue_training = int(val)
+        if name == "save_model":
+            self.save_period = int(val)
+        if name == "start_counter":
+            self.start_counter = int(val)
+        if name == "model_in":
+            self.name_model_in = val
+        if name == "model_dir":
+            self.name_model_dir = val
+        if name == "num_round":
+            self.num_round = int(val)
+        if name == "max_round":
+            self.max_round = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "task":
+            self.task = val
+        if name == "dev":
+            self.device = val
+        if name == "test_io":
+            self.test_io = int(val)
+        if name == "eval_train":
+            self.eval_train = int(val)
+        if name == "extract_node_name":
+            self.extract_node_name = val
+        if name == "output_format":
+            self.output_format = 1 if val == "txt" else 0
+        self.cfg.append((name, val))
+
+    # ------------------------------------------------------------------
+    def _create_net(self) -> NetTrainer:
+        net = NetTrainer()
+        for k, v in self.cfg:
+            net.set_param(k, v)
+        return net
+
+    def init(self) -> None:
+        if self.task == "train" and self.continue_training:
+            if self._sync_latest_model():
+                print(f"Init: Continue training from round "
+                      f"{self.start_counter}")
+                self._create_iterators()
+                return
+            # reference aborts here (cxxnet_main.cpp:109-113)
+            raise FileNotFoundError(
+                "Init: cannot find models for continue training; "
+                "specify model_in instead")
+        if self.name_model_in == "NULL":
+            assert self.task == "train", \
+                "must specify model_in if not training"
+            self.net_trainer = self._create_net()
+            self.net_trainer.init_model()
+        elif self.task == "finetune":
+            self._copy_model()
+        else:
+            self._load_model()
+        self._create_iterators()
+
+    def _model_name(self, counter: int) -> str:
+        return os.path.join(self.name_model_dir, f"{counter:04d}.model")
+
+    def _sync_latest_model(self) -> bool:
+        """Probe model_dir/%04d.model ascending, load the newest."""
+        s = self.start_counter
+        last = None
+        while os.path.exists(self._model_name(s)):
+            last = self._model_name(s)
+            s += 1
+        if last is None:
+            return False
+        self.net_trainer = self._create_net()
+        with open(last, "rb") as fi:
+            self.net_trainer.load_model(fi)
+        self.start_counter = s
+        return True
+
+    def _load_model(self) -> None:
+        base = os.path.basename(self.name_model_in)
+        try:
+            self.start_counter = int(base.split(".")[0])
+        except ValueError:
+            print("WARNING: cannot infer start_counter from model name.")
+        self.net_trainer = self._create_net()
+        with open(self.name_model_in, "rb") as fi:
+            self.net_trainer.load_model(fi)
+        self.start_counter += 1
+
+    def _copy_model(self) -> None:
+        self.net_trainer = self._create_net()
+        self.net_trainer.init_model()
+        with open(self.name_model_in, "rb") as fi:
+            self.net_trainer.copy_model_from(fi)
+
+    def _save_model(self) -> None:
+        counter = self.start_counter
+        self.start_counter += 1
+        if self.save_period == 0 or self.start_counter % self.save_period:
+            return
+        os.makedirs(self.name_model_dir, exist_ok=True)
+        with open(self._model_name(counter), "wb") as fo:
+            self.net_trainer.save_model(fo)
+
+    # ------------------------------------------------------------------
+    def _create_iterators(self) -> None:
+        flag = 0
+        evname = ""
+        itcfg: List[Tuple[str, str]] = []
+        defcfg: List[Tuple[str, str]] = []
+        for name, val in self.cfg:
+            if name == "data":
+                flag = 1
+                continue
+            if name == "eval":
+                evname = val
+                flag = 2
+                continue
+            if name == "pred":
+                flag = 3
+                self.name_pred = val
+                continue
+            if name == "iter" and val == "end":
+                assert flag != 0, "wrong configuration file"
+                if flag == 1 and self.task != "pred":
+                    assert self.itr_train is None, "can only have one data"
+                    self.itr_train = create_iterator(itcfg)
+                if flag == 2 and self.task != "pred":
+                    self.itr_evals.append(create_iterator(itcfg))
+                    self.eval_names.append(evname)
+                if flag == 3 and self.task in ("pred", "extract"):
+                    assert self.itr_pred is None, \
+                        "can only have one data:test"
+                    self.itr_pred = create_iterator(itcfg)
+                flag = 0
+                itcfg = []
+                continue
+            if flag == 0:
+                defcfg.append((name, val))
+            else:
+                itcfg.append((name, val))
+
+        def init_iter(it):
+            for k, v in defcfg:
+                it.set_param(k, v)
+            it.init()
+
+        for it in filter(None, [self.itr_train, self.itr_pred]):
+            init_iter(it)
+        for it in self.itr_evals:
+            init_iter(it)
+
+    # ------------------------------------------------------------------
+    def task_train(self) -> None:
+        start = time.time()
+        if self.continue_training == 0 and self.name_model_in == "NULL":
+            self._save_model()
+        else:
+            for it, name in zip(self.itr_evals, self.eval_names):
+                sys.stderr.write(self.net_trainer.evaluate(it, name))
+            sys.stderr.write("\n")
+            sys.stderr.flush()
+
+        if self.itr_train is None:
+            return
+        if self.test_io:
+            print("start I/O test")
+        cc = self.max_round
+        while self.start_counter <= self.num_round and cc > 0:
+            cc -= 1
+            if not self.silent:
+                print(f"update round {self.start_counter - 1}")
+            sample_counter = 0
+            self.net_trainer.start_round(self.start_counter)
+            self.itr_train.before_first()
+            while self.itr_train.next():
+                if self.test_io == 0:
+                    self.net_trainer.update(self.itr_train.value())
+                sample_counter += 1
+                if sample_counter % self.print_step == 0 and not self.silent:
+                    elapsed = int(time.time() - start)
+                    print(f"round {self.start_counter - 1:8d}:"
+                          f"[{sample_counter:8d}] {elapsed} sec elapsed")
+            if self.test_io == 0:
+                sys.stderr.write(f"[{self.start_counter}]")
+                if self.eval_train:
+                    sys.stderr.write(
+                        self.net_trainer.eval_train_metric())
+                for it, name in zip(self.itr_evals, self.eval_names):
+                    sys.stderr.write(self.net_trainer.evaluate(it, name))
+                sys.stderr.write("\n")
+                sys.stderr.flush()
+            self._save_model()
+        if not self.silent:
+            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+
+    def task_predict(self) -> None:
+        assert self.itr_pred is not None, \
+            "must specify a predict iterator to generate predictions"
+        print("start predicting...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                pred = self.net_trainer.predict(batch)
+                for v in pred:
+                    fo.write(f"{v:g}\n")
+        print(f"finished prediction, write into {self.name_pred}")
+
+    def task_extract_feature(self) -> None:
+        assert self.itr_pred is not None, \
+            "must specify a predict iterator to generate predictions"
+        assert self.extract_node_name, \
+            "extract node name must be specified in task extract"
+        print("start predicting...")
+        nrow = 0
+        dshape = None
+        mode = "w" if self.output_format else "wb"
+        with open(self.name_pred, mode) as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                feat = self.net_trainer.extract_feature(
+                    batch, self.extract_node_name)
+                nrow += feat.shape[0]
+                dshape = feat.shape[1:]
+                flat = feat.reshape(feat.shape[0], -1)
+                if self.output_format:
+                    for row in flat:
+                        fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+                else:
+                    flat.astype("float32").tofile(fo)
+        with open(self.name_pred + ".meta", "w") as fm:
+            fm.write(f"{nrow},{dshape[0]},{dshape[1]},{dshape[2]}\n")
+        print(f"finished prediction, write into {self.name_pred}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    return LearnTask().run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

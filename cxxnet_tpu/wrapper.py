@@ -1,0 +1,162 @@
+"""numpy-facing wrapper API.
+
+API parity with wrapper/cxxnet.py:64-312 (`Net`, `DataIter`, `train()`):
+the reference reaches the C++ core over a ctypes C ABI
+(wrapper/cxxnet_wrapper.cpp); here the same surface binds directly to the
+in-process trainer - same call signatures and semantics, numpy in/out.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import numpy as np
+
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+
+class DataIter:
+    """Config-built data iterator (CXNIOCreateFromConfig semantics)."""
+
+    def __init__(self, cfg: str):
+        self._it = create_iterator(parse_config_string(cfg))
+        self._it.init()
+        self.head = True
+        self.tail = False
+
+    def next(self) -> bool:
+        ret = self._it.next()
+        self.head = False
+        self.tail = not ret
+        return ret
+
+    def before_first(self) -> None:
+        self._it.before_first()
+        self.head = True
+        self.tail = False
+
+    def check_valid(self) -> None:
+        if self.head:
+            raise RuntimeError(
+                "iterator at head state, call next to get to valid state")
+        if self.tail:
+            raise RuntimeError("iterator reaches end")
+
+    def get_data(self) -> np.ndarray:
+        self.check_valid()
+        return self._it.value().data
+
+    def get_label(self) -> np.ndarray:
+        self.check_valid()
+        return self._it.value().label
+
+    @property
+    def value(self) -> DataBatch:
+        self.check_valid()
+        return self._it.value()
+
+
+def _batch_from_numpy(data: np.ndarray,
+                      label: Optional[np.ndarray]) -> DataBatch:
+    if data.ndim != 4:
+        raise ValueError(
+            "need 4 dimensional tensor (batch, channel, height, width)")
+    if label is None:
+        label = np.zeros((data.shape[0], 1), dtype=np.float32)
+    label = np.asarray(label, dtype=np.float32)
+    if label.ndim == 1:
+        label = label.reshape(-1, 1)
+    if label.shape[0] != data.shape[0]:
+        raise ValueError("data size mismatch")
+    return DataBatch(data=np.asarray(data, dtype=np.float32), label=label)
+
+
+class Net:
+    """Neural net object (CXNNetCreate semantics)."""
+
+    def __init__(self, dev: str = "cpu", cfg: str = ""):
+        self._net = NetTrainer(dev=dev, cfg=cfg)
+
+    def set_param(self, name, value) -> None:
+        self._net.set_param(str(name), str(value))
+
+    def init_model(self) -> None:
+        self._net.init_model()
+
+    def load_model(self, fname: str) -> None:
+        with open(fname, "rb") as f:
+            self._net.load_model(f)
+
+    def save_model(self, fname: str) -> None:
+        with open(fname, "wb") as f:
+            self._net.save_model(f)
+
+    def start_round(self, round_counter: int) -> None:
+        self._net.start_round(round_counter)
+
+    def update(self, data: Union[DataIter, np.ndarray],
+               label: Optional[np.ndarray] = None) -> None:
+        if isinstance(data, DataIter):
+            data.check_valid()
+            self._net.update(data.value)
+        elif isinstance(data, np.ndarray):
+            if label is None:
+                raise ValueError("need label to use update")
+            self._net.update(_batch_from_numpy(data, label))
+        else:
+            raise TypeError(f"update does not support type {type(data)}")
+
+    def evaluate(self, data: DataIter, name: str) -> str:
+        if not isinstance(data, DataIter):
+            raise TypeError("evaluate expects a DataIter")
+        return self._net.evaluate(data._it, name)
+
+    def predict(self, data: Union[DataIter, np.ndarray]) -> np.ndarray:
+        if isinstance(data, DataIter):
+            data.check_valid()
+            return self._net.predict(data.value)
+        return self._net.predict(_batch_from_numpy(data, None))
+
+    def predict_dist(self,
+                     data: Union[DataIter, np.ndarray]) -> np.ndarray:
+        if isinstance(data, DataIter):
+            data.check_valid()
+            return self._net.predict_dist(data.value)
+        return self._net.predict_dist(_batch_from_numpy(data, None))
+
+    def extract(self, data: Union[DataIter, np.ndarray],
+                node_name: str) -> np.ndarray:
+        if isinstance(data, DataIter):
+            data.check_valid()
+            return self._net.extract_feature(data.value, node_name)
+        return self._net.extract_feature(_batch_from_numpy(data, None),
+                                         node_name)
+
+    def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
+        w, _ = self._net.get_weight(layer_name, tag)
+        return w
+
+    def set_weight(self, weight: np.ndarray, layer_name: str,
+                   tag: str) -> None:
+        self._net.set_weight(np.asarray(weight, dtype=np.float32),
+                             layer_name, tag)
+
+
+def train(cfg: str, data, label, num_round: int,
+          param, eval_data=None, batch_size: int = 128,
+          dev: str = "cpu") -> Net:
+    """Convenience trainer over numpy arrays (cxxnet.py:301-312)."""
+    net = Net(dev=dev, cfg=cfg)
+    net.set_param("batch_size", batch_size)
+    for k, v in (param.items() if isinstance(param, dict) else param):
+        net.set_param(k, v)
+    net.init_model()
+    n = data.shape[0]
+    for r in range(num_round):
+        net.start_round(r)
+        for i in range(0, n - batch_size + 1, batch_size):
+            net.update(data[i:i + batch_size], label[i:i + batch_size])
+    return net

@@ -1,0 +1,112 @@
+"""Tests for the numpy wrapper API (wrapper/cxxnet.py parity)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.wrapper import DataIter, Net, train
+
+NET_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1] = tanh
+layer[+1] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,6
+metric = error
+silent = 1
+"""
+
+
+def synth(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 1, 1, 6).astype(np.float32)
+    y = (x.reshape(n, 6).sum(axis=1) > 0).astype(np.float32)
+    return x, y
+
+
+def test_net_update_numpy_batches():
+    x, y = synth()
+    net = Net(dev="cpu", cfg=NET_CFG)
+    net.set_param("batch_size", 32)
+    net.set_param("eta", 0.5)
+    net.init_model()
+    for r in range(10):
+        net.start_round(r)
+        for i in range(0, 128, 32):
+            net.update(x[i:i + 32], y[i:i + 32])
+    pred = net.predict(x[:32])
+    assert (pred == y[:32]).mean() > 0.9
+
+
+def test_net_label_validation():
+    net = Net(dev="cpu", cfg=NET_CFG)
+    net.set_param("batch_size", 4)
+    net.set_param("eta", 0.1)
+    net.init_model()
+    x, y = synth(4)
+    with pytest.raises(ValueError):
+        net.update(x, None)
+    with pytest.raises(ValueError):
+        net.update(x, y[:2])
+    with pytest.raises(ValueError):
+        net.update(x.reshape(4, 6), y)  # not 4-d
+
+
+def test_get_set_weight_roundtrip():
+    net = Net(dev="cpu", cfg=NET_CFG)
+    net.set_param("batch_size", 4)
+    net.init_model()
+    w = net.get_weight("fc1", "wmat")
+    assert w.shape == (16, 6)
+    net.set_weight(np.ones_like(w), "fc1", "wmat")
+    np.testing.assert_allclose(net.get_weight("fc1", "wmat"), 1.0)
+
+
+def test_train_convenience():
+    x, y = synth(256)
+    net = train(NET_CFG, x, y, num_round=8,
+                param={"eta": 0.5, "momentum": 0.9}, batch_size=32,
+                dev="cpu")
+    pred = net.predict(x[:32])
+    assert (pred == y[:32]).mean() > 0.85
+
+
+def test_wrapper_dataiter(tmp_path):
+    n, rows, cols = 64, 4, 4
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, size=(n, rows, cols), dtype=np.uint8)
+    labels = rng.randint(0, 2, size=n, dtype=np.uint8)
+    img_path, lbl_path = str(tmp_path / "i.gz"), str(tmp_path / "l.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, n, rows, cols))
+        f.write(images.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">ii", 2049, n))
+        f.write(labels.tobytes())
+
+    it = DataIter(f"""
+iter = mnist
+path_img = "{img_path}"
+path_label = "{lbl_path}"
+batch_size = 16
+silent = 1
+""")
+    with pytest.raises(RuntimeError):
+        it.get_data()  # head state
+    assert it.next()
+    assert it.get_data().shape == (16, 1, 1, 16)
+    assert it.get_label().shape == (16, 1)
+    cnt = 1
+    while it.next():
+        cnt += 1
+    assert cnt == 4
+    it.before_first()
+    assert it.next()

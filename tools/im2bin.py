@@ -7,9 +7,11 @@ lines and appends each image file's raw bytes as one blob.
 Usage: im2bin.py <image.lst> <image_root> <output.bin>
 """
 
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 from cxxnet_tpu.io.iter_img import parse_list_file  # noqa: E402
 from cxxnet_tpu.utils.binary_page import BinaryPageWriter  # noqa: E402
@@ -31,8 +33,12 @@ def im2bin(list_path: str, image_root: str, out_path: str) -> int:
     return count
 
 
-if __name__ == "__main__":
+def cli_main() -> None:
     if len(sys.argv) != 4:
         print(__doc__)
         sys.exit(1)
     im2bin(sys.argv[1], sys.argv[2], sys.argv[3])
+
+
+if __name__ == "__main__":
+    cli_main()
