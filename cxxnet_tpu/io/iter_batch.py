@@ -18,6 +18,7 @@ import numpy as np
 
 from cxxnet_tpu.io.data import DataBatch, DataInst
 from cxxnet_tpu.io.iterators import DataIter
+from cxxnet_tpu.io.thread_util import drain_and_join, stoppable_put
 
 
 class BatchAdaptIterator(DataIter):
@@ -138,23 +139,13 @@ class ThreadBufferIterator(DataIter):
             print(f"ThreadBufferIterator: buffer_size={self.buffer_size}")
 
     def _producer(self, q: "queue.Queue", stop: threading.Event) -> None:
-        def put(item) -> bool:
-            # bounded put that aborts on stop so shutdown can't deadlock
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
         try:
             self.base.before_first()
             while not stop.is_set() and self.base.next():
-                if not put(self.base.value()):
+                if not stoppable_put(q, stop, self.base.value()):
                     return
         finally:
-            put(None)
+            stoppable_put(q, stop, None)
 
     def before_first(self) -> None:
         self._shutdown()
@@ -166,15 +157,7 @@ class ThreadBufferIterator(DataIter):
 
     def _shutdown(self) -> None:
         if self._thread is not None:
-            self._stop.set()
-            while self._thread.is_alive():
-                # drain so any pending put unblocks, then wait
-                try:
-                    while True:
-                        self._q.get_nowait()
-                except queue.Empty:
-                    pass
-                self._thread.join(timeout=0.1)
+            drain_and_join(self._q, self._thread, self._stop)
             self._thread = None
 
     def next(self) -> bool:

@@ -23,6 +23,7 @@ import numpy as np
 
 from cxxnet_tpu.io.data import DataInst
 from cxxnet_tpu.io.iterators import DataIter
+from cxxnet_tpu.io.thread_util import drain_and_join, stoppable_put
 from cxxnet_tpu.utils.binary_page import iter_page_blobs
 
 
@@ -121,14 +122,7 @@ class _PageReader(threading.Thread):
         self.stop_event = stop
 
     def _put(self, item) -> bool:
-        """Bounded put that aborts when asked to stop."""
-        while not self.stop_event.is_set():
-            try:
-                self.out_q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+        return stoppable_put(self.out_q, self.stop_event, item)
 
     def run(self) -> None:
         try:
@@ -228,14 +222,7 @@ class ImageBinIterator(DataIter):
         reader = getattr(self, "_reader", None)
         if reader is None or not reader.is_alive():
             return
-        self._stop.set()
-        while reader.is_alive():
-            try:
-                while True:
-                    self._q.get_nowait()
-            except queue.Empty:
-                pass
-            reader.join(timeout=0.1)
+        drain_and_join(self._q, reader, self._stop)
         self._reader = None
 
     def _next_page(self) -> bool:

@@ -1,0 +1,41 @@
+"""Shared helpers for the queue-backed producer threads in io.
+
+The role of utils/thread_buffer.h (thread_buffer.h:22-202) — a bounded
+producer/consumer handoff with a shutdown protocol that can't deadlock:
+the producer only ever blocks in a stop-aware put, and the consumer side
+drains the queue while joining so a pending put always unblocks.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+
+def stoppable_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
+    """Bounded put that aborts when `stop` is set. Returns False if
+    aborted (the producer should exit)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def drain_and_join(q: "queue.Queue", thread: threading.Thread,
+                   stop: threading.Event, timeout: float = 5.0) -> None:
+    """Stop a producer: set the flag, drain so a pending put unblocks,
+    join with a bounded total wait. A producer stuck outside q.put (e.g.
+    a stalled read) is abandoned as a daemon thread after `timeout`."""
+    stop.set()
+    deadline = time.monotonic() + timeout
+    while thread.is_alive() and time.monotonic() < deadline:
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        thread.join(timeout=0.1)

@@ -178,6 +178,10 @@ class LearnTask:
             self.net_trainer.copy_model_from(fi)
 
     def _save_model(self) -> None:
+        # quirk parity: the modulo check uses the POST-incremented counter
+        # (cxxnet_main.cpp:173-176), so with save_model=k the rounds saved
+        # are k-1, 2k-1, ... — e.g. save_model=num_round=15 writes only
+        # 0014.model. Kept so round numbering matches the reference.
         counter = self.start_counter
         self.start_counter += 1
         if self.save_period == 0 or self.start_counter % self.save_period:
@@ -206,10 +210,10 @@ class LearnTask:
                 continue
             if name == "iter" and val == "end":
                 assert flag != 0, "wrong configuration file"
-                if flag == 1 and self.task != "pred":
+                if flag == 1 and self.task not in ("pred", "extract"):
                     assert self.itr_train is None, "can only have one data"
                     self.itr_train = create_iterator(itcfg)
-                if flag == 2 and self.task != "pred":
+                if flag == 2 and self.task not in ("pred", "extract"):
                     self.itr_evals.append(create_iterator(itcfg))
                     self.eval_names.append(evname)
                 if flag == 3 and self.task in ("pred", "extract"):

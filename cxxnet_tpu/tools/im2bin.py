@@ -7,14 +7,10 @@ lines and appends each image file's raw bytes as one blob.
 Usage: im2bin.py <image.lst> <image_root> <output.bin>
 """
 
-import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
-from cxxnet_tpu.io.iter_img import parse_list_file  # noqa: E402
-from cxxnet_tpu.utils.binary_page import BinaryPageWriter  # noqa: E402
+from cxxnet_tpu.io.iter_img import parse_list_file
+from cxxnet_tpu.utils.binary_page import BinaryPageWriter
 
 
 def im2bin(list_path: str, image_root: str, out_path: str) -> int:

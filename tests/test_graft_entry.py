@@ -1,0 +1,17 @@
+"""Driver-contract checks: dryrun_multichip on the virtual 8-device CPU
+mesh (conftest forces the platform), and entry() buildability."""
+
+import numpy as np
+
+import __graft_entry__ as ge
+
+
+def test_dryrun_multichip_8():
+    ge.dryrun_multichip(8)
+
+
+def test_entry_builds_flagship():
+    fn, (params, data) = ge.entry()
+    assert data.shape == (32, 3, 227, 227)
+    # flagship net: AlexNet fc8 produces 1000-way logits
+    assert params["fc8"]["wmat"].shape[0] == 1000

@@ -154,9 +154,7 @@ silent = 1
 
 def test_imgbin_pipeline(tmp_path):
     lst, root, labels = write_images(tmp_path)
-    import sys
-    sys.path.insert(0, "/root/repo/tools")
-    from im2bin import im2bin
+    from cxxnet_tpu.tools.im2bin import im2bin
     bin_path = str(tmp_path / "data.bin")
     assert im2bin(lst, root, bin_path) == 12
     it = make_iter(f"""
@@ -179,9 +177,7 @@ silent = 1
 def test_imgbin_matches_img(tmp_path):
     """Decoding from the bin equals decoding the loose files."""
     lst, root, _ = write_images(tmp_path)
-    import sys
-    sys.path.insert(0, "/root/repo/tools")
-    from im2bin import im2bin
+    from cxxnet_tpu.tools.im2bin import im2bin
     bin_path = str(tmp_path / "data.bin")
     im2bin(lst, root, bin_path)
     common = f"""
@@ -329,9 +325,7 @@ silent = 1
 def test_imgbin_restart_no_reader_leak(tmp_path):
     import threading
     lst, root, _ = write_images(tmp_path)
-    import sys
-    sys.path.insert(0, "/root/repo/tools")
-    from im2bin import im2bin
+    from cxxnet_tpu.tools.im2bin import im2bin
     bin_path = str(tmp_path / "data.bin")
     im2bin(lst, root, bin_path)
     it = make_iter(f"""
@@ -369,7 +363,5 @@ silent = 1
     batches = list(it)
     assert len(batches) == 3  # no duplicates, refilled cleanly
     np.testing.assert_allclose(batches[0].label, first)
-    labels = np.concatenate([b.label for b in batches])
-    assert len(labels) == len(np.unique(labels, axis=0)) or True
     # consecutive epochs identical
     assert len(list(it)) == 3
