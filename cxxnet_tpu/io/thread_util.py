@@ -26,10 +26,14 @@ def stoppable_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
 
 
 def drain_and_join(q: "queue.Queue", thread: threading.Thread,
-                   stop: threading.Event, timeout: float = 5.0) -> None:
+                   stop: threading.Event, timeout: float = 30.0) -> None:
     """Stop a producer: set the flag, drain so a pending put unblocks,
-    join with a bounded total wait. A producer stuck outside q.put (e.g.
-    a stalled read) is abandoned as a daemon thread after `timeout`."""
+    join with a bounded total wait.
+
+    Raises RuntimeError if the producer is still alive after `timeout`
+    (stuck outside q.put, e.g. a stalled read): restarting on top of a
+    live producer would race it on the shared underlying iterator, so a
+    stuck pipeline must fail loudly instead."""
     stop.set()
     deadline = time.monotonic() + timeout
     while thread.is_alive() and time.monotonic() < deadline:
@@ -39,3 +43,7 @@ def drain_and_join(q: "queue.Queue", thread: threading.Thread,
         except queue.Empty:
             pass
         thread.join(timeout=0.1)
+    if thread.is_alive():
+        raise RuntimeError(
+            f"io producer thread failed to stop within {timeout}s "
+            "(stalled read?); cannot safely restart the pipeline")

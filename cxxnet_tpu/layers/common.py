@@ -197,10 +197,11 @@ class PoolingLayer(Layer):
         p = self.param
         if p.kernel_height <= 0 or p.kernel_width <= 0:
             raise ValueError("must set kernel_size correctly")
-        if p.kernel_width > w or p.kernel_height > h:
+        if (p.kernel_width > w + 2 * p.pad_x
+                or p.kernel_height > h + 2 * p.pad_y):
             raise ValueError("kernel size exceeds input")
-        oh = ops.pool_out_dim(h, p.kernel_height, p.stride)
-        ow = ops.pool_out_dim(w, p.kernel_width, p.stride)
+        oh = ops.pool_out_dim(h, p.kernel_height, p.stride, p.pad_y)
+        ow = ops.pool_out_dim(w, p.kernel_width, p.stride, p.pad_x)
         return [(b, c, oh, ow)]
 
     def apply(self, params, inputs, *, train, rng=None):
@@ -209,7 +210,7 @@ class PoolingLayer(Layer):
             x = ops.relu(x)
         p = self.param
         return [ops.pool2d(x, self.mode, p.kernel_height, p.kernel_width,
-                           p.stride)]
+                           p.stride, p.pad_y, p.pad_x)]
 
 
 @register_layer
@@ -250,6 +251,13 @@ class InsanityPoolingLayer(PoolingLayer):
     def __init__(self, name: str = ""):
         super().__init__(name)
         self.p_keep = 1.0
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        if self.param.pad_x or self.param.pad_y:
+            raise ValueError(
+                "insanity_max_pooling does not support pad (the jitter "
+                "clamps at the true image border)")
+        return super().infer_shapes(in_shapes)
 
     def set_param(self, name: str, val: str) -> None:
         super().set_param(name, val)

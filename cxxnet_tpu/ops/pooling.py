@@ -20,23 +20,30 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def pool_out_dim(in_dim: int, ksize: int, stride: int) -> int:
-    """The reference pooling output-size formula."""
+def pool_out_dim(in_dim: int, ksize: int, stride: int, pad: int = 0) -> int:
+    """The reference pooling output-size formula (pad is an extension over
+    the reference, which has no pooling padding; pad=0 is exact parity)."""
+    in_dim = in_dim + 2 * pad
     return min(in_dim - ksize + stride - 1, in_dim - 1) // stride + 1
 
 
-def _pool_padding(in_dim: int, ksize: int, stride: int) -> int:
+def _pool_padding(in_dim: int, ksize: int, stride: int, pad: int) -> int:
     """High padding needed so reduce_window emits pool_out_dim outputs."""
-    out = pool_out_dim(in_dim, ksize, stride)
-    return max(0, (out - 1) * stride + ksize - in_dim)
+    out = pool_out_dim(in_dim, ksize, stride, pad)
+    return max(0, (out - 1) * stride + ksize - (in_dim + pad))
 
 
 def pool2d(x: jax.Array, mode: str, ksize_y: int, ksize_x: int,
-           stride: int) -> jax.Array:
-    """Pool an NCHW tensor. mode in {'max', 'sum', 'avg'}."""
-    pad_y = _pool_padding(x.shape[2], ksize_y, stride)
-    pad_x = _pool_padding(x.shape[3], ksize_x, stride)
-    padding = ((0, 0), (0, 0), (0, pad_y), (0, pad_x))
+           stride: int, pad_y: int = 0, pad_x: int = 0) -> jax.Array:
+    """Pool an NCHW tensor. mode in {'max', 'sum', 'avg'}.
+
+    pad_y/pad_x symmetrically pad before pooling (inception-style
+    same-size pooling); padding is neutral for the reducer (-inf for
+    max, 0 for sum/avg) and avg still divides by the full window size.
+    """
+    hi_y = _pool_padding(x.shape[2], ksize_y, stride, pad_y)
+    hi_x = _pool_padding(x.shape[3], ksize_x, stride, pad_x)
+    padding = ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x))
     window = (1, 1, ksize_y, ksize_x)
     strides = (1, 1, stride, stride)
     if mode == "max":
