@@ -160,6 +160,12 @@ class ImageBinIterator(DataIter):
         self.dist_num_worker = 1
         self.dist_worker_rank = 0
         self.rng = np.random.RandomState(self.K_RAND_MAGIC)
+        # native decode pipeline: -1 auto (use when built), 0 off, 1 force
+        self.use_native = -1
+        self.decode_threads = 4
+        self.shuffle_buffer = 1024
+        self._native = None
+        self._native_mode = False
 
     def set_param(self, name: str, val: str) -> None:
         if name == "image_list":
@@ -182,6 +188,12 @@ class ImageBinIterator(DataIter):
             self.dist_worker_rank = int(val)
         if name == "seed_data":
             self.rng = np.random.RandomState(self.K_RAND_MAGIC + int(val))
+        if name == "use_native":
+            self.use_native = int(val)
+        if name == "decode_threads":
+            self.decode_threads = int(val)
+        if name == "shuffle_buffer":
+            self.shuffle_buffer = int(val)
 
     def _expand_templates(self) -> Tuple[List[str], List[str]]:
         """image_conf_prefix with %d + image_conf_ids `a-b` -> shard lists
@@ -197,17 +209,35 @@ class ImageBinIterator(DataIter):
         return lists, bins
 
     def init(self) -> None:
+        from cxxnet_tpu.io.native import native_available
         lists, bins = self._expand_templates()
         self.entries = []
         for lst in lists:
             self.entries.extend(parse_list_file(lst))
         self.bins = bins
+        if self.use_native == 1 and not native_available():
+            raise RuntimeError(
+                "use_native=1 but libcxxnet_io.so is not available "
+                "(run `make -C native`)")
+        if self.shuffle and self.shuffle_buffer < 1:
+            raise ValueError("shuffle=1 requires shuffle_buffer >= 1")
+        self._native_mode = (self.use_native != 0 and native_available())
         if not self.silent:
+            mode = "native" if self._native_mode else "python"
             print(f"ImageBinIterator: {len(self.entries)} images from "
-                  f"{len(bins)} bins")
+                  f"{len(bins)} bins ({mode} decode)")
         self.before_first()
 
     def before_first(self) -> None:
+        if self._native_mode:
+            from cxxnet_tpu.io.native import NativeBinReader
+            if self._native is None:
+                self._native = NativeBinReader(
+                    self.bins, n_threads=self.decode_threads)
+            self._native.before_first()
+            self._nseq = 0
+            self._nbuf: List[DataInst] = []
+            return
         self._shutdown_reader()
         self._stop = threading.Event()
         self._q: "queue.Queue" = queue.Queue(maxsize=4)
@@ -236,7 +266,49 @@ class ImageBinIterator(DataIter):
         self._page_pos = 0
         return True
 
+    def _pull_native(self) -> Optional[DataInst]:
+        data = self._native.next()
+        if data is None:
+            return None
+        idx, labels, _ = self.entries[self._nseq]
+        self._nseq += 1
+        label = np.asarray(labels[:self.label_width], dtype=np.float32)
+        return DataInst(index=idx, data=data, label=label)
+
+    def _next_native(self) -> bool:
+        """Native stream is strictly ordered; shuffle uses a bounded
+        reservoir (the analog of the Python path's within-page shuffle).
+        The reservoir is additionally capped to ~64MiB of decoded floats
+        (the page-shuffle window size) so large images don't pin GBs."""
+        if self.shuffle:
+            if not self._nbuf:
+                inst = self._pull_native()
+                if inst is not None:
+                    self._nbuf.append(inst)
+            if self._nbuf:
+                per_img = max(1, self._nbuf[0].data.nbytes)
+                cap = min(self.shuffle_buffer,
+                          max(16, (64 << 20) // per_img))
+                while len(self._nbuf) < cap:
+                    inst = self._pull_native()
+                    if inst is None:
+                        break
+                    self._nbuf.append(inst)
+            if not self._nbuf:
+                return False
+            j = int(self.rng.randint(len(self._nbuf)))
+            self._nbuf[j], self._nbuf[-1] = self._nbuf[-1], self._nbuf[j]
+            self._out = self._nbuf.pop()
+            return True
+        inst = self._pull_native()
+        if inst is None:
+            return False
+        self._out = inst
+        return True
+
     def next(self) -> bool:
+        if self._native_mode:
+            return self._next_native()
         while self._page_pos >= len(self._page_objs):
             if not self._next_page():
                 return False

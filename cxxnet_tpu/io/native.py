@@ -1,0 +1,143 @@
+"""ctypes binding for the native io pipeline (native/cxxnet_io.cc).
+
+The native library implements the reference's two-stage decode pipeline
+(iter_thread_imbin_x-inl.hpp:18-397) in C++: a page-reader thread streams
+64MiB BinaryPages, a worker pool decodes JPEG/PNG blobs off the GIL, and
+records are handed back strictly in stream order. Python keeps the .lst
+parsing, label join, shuffle, augmentation, and batching.
+
+The library is searched at cxxnet_tpu/lib/libcxxnet_io.so (built by
+`make -C native`) or $CXXNET_TPU_NATIVE; when g++ is available and the
+library is missing it is built on demand. `native_available()` gates all
+use; every consumer falls back to the pure-Python decoder.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_LIB_NAME = "libcxxnet_io.so"
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+class CxioRecord(ctypes.Structure):
+    _fields_ = [("data", ctypes.POINTER(ctypes.c_ubyte)),
+                ("h", ctypes.c_int),
+                ("w", ctypes.c_int),
+                ("c", ctypes.c_int)]
+
+
+def _lib_path() -> str:
+    env = os.environ.get("CXXNET_TPU_NATIVE")
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lib", _LIB_NAME)
+
+
+def _try_build(path: str) -> bool:
+    """Build the library from native/ if the source tree is present."""
+    global _build_attempted
+    if _build_attempted:
+        return os.path.exists(path)
+    _build_attempted = True
+    native_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+    if not os.path.exists(os.path.join(native_dir, "Makefile")):
+        return False
+    try:
+        subprocess.run(["make", "-C", native_dir], check=True,
+                       capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return False
+    return os.path.exists(path)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _lib_path()
+        if not os.path.exists(path) and not _try_build(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.cxio_open.restype = ctypes.c_void_p
+        lib.cxio_open.argtypes = [ctypes.POINTER(ctypes.c_char_p),
+                                  ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int]
+        lib.cxio_before_first.argtypes = [ctypes.c_void_p]
+        lib.cxio_next.restype = ctypes.c_int
+        lib.cxio_next.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(CxioRecord)]
+        lib.cxio_last_error.restype = ctypes.c_char_p
+        lib.cxio_last_error.argtypes = [ctypes.c_void_p]
+        lib.cxio_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeBinReader:
+    """Ordered record stream over one or more .bin files."""
+
+    def __init__(self, bin_paths: List[str], n_threads: int = 4,
+                 max_inflight: int = 64):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native io library unavailable")
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(bin_paths))(
+            *[p.encode() for p in bin_paths])
+        self._h = lib.cxio_open(arr, len(bin_paths), n_threads,
+                                max_inflight, 1)
+        self._rec = CxioRecord()
+
+    def before_first(self) -> None:
+        self._lib.cxio_before_first(self._h)
+
+    def next(self) -> Optional[np.ndarray]:
+        """Next decoded image as (c,h,w) float32 RGB, or the raw blob
+        decoded via PIL when the native decoders could not handle it.
+        None at end of stream (raises on stream error)."""
+        if not self._lib.cxio_next(self._h, ctypes.byref(self._rec)):
+            err = self._lib.cxio_last_error(self._h)
+            if err:
+                raise IOError(err.decode())
+            return None
+        r = self._rec
+        if r.c == 0:  # undecodable natively; PIL fallback on the raw blob
+            from cxxnet_tpu.io.iter_img import decode_image
+            blob = ctypes.string_at(r.data, r.w)
+            return decode_image(blob)
+        # float mode: the record already is CHW float32 (converted on the
+        # native worker threads); one memcpy to own the buffer
+        fptr = ctypes.cast(r.data, ctypes.POINTER(ctypes.c_float))
+        n = r.h * r.w * r.c
+        return np.ctypeslib.as_array(fptr, shape=(n,)).reshape(
+            r.c, r.h, r.w).copy()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.cxio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -197,6 +197,10 @@ class PoolingLayer(Layer):
         p = self.param
         if p.kernel_height <= 0 or p.kernel_width <= 0:
             raise ValueError("must set kernel_size correctly")
+        if p.pad_x >= p.kernel_width or p.pad_y >= p.kernel_height:
+            raise ValueError(
+                "pooling pad must be smaller than the kernel (all-padding "
+                "windows would emit -inf/0)")
         if (p.kernel_width > w + 2 * p.pad_x
                 or p.kernel_height > h + 2 * p.pad_y):
             raise ValueError("kernel size exceeds input")

@@ -1,0 +1,384 @@
+// Native data-plane for cxxnet_tpu: BinaryPage streaming + parallel
+// image decode with an ordered hand-off to Python.
+//
+// Role parity with the reference's native io stack:
+//   - BinaryPage format       src/utils/io.h:254-326 (64MiB packed pages)
+//   - two-stage pipeline      src/io/iter_thread_imbin_x-inl.hpp:18-397
+//     (page-loader thread -> decode worker pool -> ordered consumer)
+//   - in-memory decoders      src/utils/decoder.h:21-130 (libjpeg + setjmp
+//     error recovery; libpng instead of OpenCV for the PNG path)
+//
+// The consumer (Python, via ctypes) pulls records strictly in stream
+// order; decode parallelism is hidden behind a reorder buffer. All
+// buffers are owned by the handle and valid until the next cxio_next /
+// cxio_before_first / cxio_close on that handle.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <csetjmp>
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+constexpr int64_t kPageNumInts = 64 << 18;
+constexpr int64_t kPageSize = 4 * kPageNumInts;  // 64 MiB
+
+struct Decoded {
+  std::vector<unsigned char> pixels;  // HWC RGB u8, or raw blob on failure
+  std::vector<float> chw;             // CHW float32 when float mode is on
+  int h = 0, w = 0, c = 0;            // c == 0 -> pixels holds the raw blob
+};
+
+// HWC u8 -> CHW float32 (the DataInst layout), done on the worker thread
+// so the Python consumer gets a zero-copy ready tensor.
+void ToChwFloat(Decoded* d) {
+  const size_t hw = static_cast<size_t>(d->h) * d->w;
+  d->chw.resize(hw * d->c);
+  const unsigned char* src = d->pixels.data();
+  for (int ch = 0; ch < d->c; ++ch) {
+    float* dst = d->chw.data() + ch * hw;
+    const unsigned char* s = src + ch;
+    for (size_t i = 0; i < hw; ++i) dst[i] = s[i * 3];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// decoders
+// ---------------------------------------------------------------------------
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  JpegErrorMgr* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+bool DecodeJpeg(const unsigned char* buf, size_t len, Decoded* out) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  out->w = cinfo.output_width;
+  out->h = cinfo.output_height;
+  out->c = 3;
+  out->pixels.resize(static_cast<size_t>(out->h) * out->w * 3);
+  const size_t stride = static_cast<size_t>(out->w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out->pixels.data() + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool DecodePng(const unsigned char* buf, size_t len, Decoded* out) {
+  png_image image;
+  std::memset(&image, 0, sizeof(image));
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&image, buf, len)) return false;
+  image.format = PNG_FORMAT_RGB;
+  out->w = image.width;
+  out->h = image.height;
+  out->c = 3;
+  out->pixels.resize(PNG_IMAGE_SIZE(image));
+  if (!png_image_finish_read(&image, nullptr, out->pixels.data(), 0,
+                             nullptr)) {
+    png_image_free(&image);
+    return false;
+  }
+  return true;
+}
+
+void DecodeBlob(std::vector<unsigned char> blob, Decoded* out) {
+  bool ok = false;
+  if (blob.size() >= 2 && blob[0] == 0xFF && blob[1] == 0xD8) {
+    ok = DecodeJpeg(blob.data(), blob.size(), out);
+  } else if (blob.size() >= 8 && blob[0] == 0x89 && blob[1] == 'P') {
+    ok = DecodePng(blob.data(), blob.size(), out);
+  }
+  if (!ok) {  // unknown / corrupt: hand the raw blob back to Python
+    out->pixels = std::move(blob);
+    out->h = 0;
+    out->w = static_cast<int>(out->pixels.size());
+    out->c = 0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// pipeline
+// ---------------------------------------------------------------------------
+
+struct Task {
+  int64_t seq;
+  std::vector<unsigned char> blob;
+};
+
+class Pipeline {
+ public:
+  Pipeline(std::vector<std::string> paths, int n_threads, int max_inflight,
+           bool float_chw)
+      : paths_(std::move(paths)),
+        n_threads_(std::max(1, n_threads)),
+        max_inflight_(std::max(2, max_inflight)),
+        float_chw_(float_chw) {}
+
+  ~Pipeline() { Stop(); }
+
+  void Start() {
+    Stop();
+    stop_.store(false);
+    eof_ = false;
+    next_seq_ = 0;
+    consume_seq_ = 0;
+    tasks_.clear();
+    done_.clear();
+    error_.clear();
+    reader_ = std::thread(&Pipeline::ReaderMain, this);
+    for (int i = 0; i < n_threads_; ++i)
+      workers_.emplace_back(&Pipeline::WorkerMain, this);
+  }
+
+  void Stop() {
+    stop_.store(true);
+    cv_task_.notify_all();
+    cv_done_.notify_all();
+    cv_space_.notify_all();
+    if (reader_.joinable()) reader_.join();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+    workers_.clear();
+  }
+
+  // Pull the next record in stream order; false at end of stream.
+  bool Next(Decoded* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_done_.wait(lk, [&] {
+      return stop_.load() || !error_.empty() ||
+             done_.count(consume_seq_) ||
+             (eof_ && consume_seq_ >= next_seq_ && tasks_.empty() &&
+              inflight_ == 0);
+    });
+    if (stop_.load() || !error_.empty()) return false;
+    auto it = done_.find(consume_seq_);
+    if (it == done_.end()) return false;  // clean EOF
+    *out = std::move(it->second);
+    done_.erase(it);
+    ++consume_seq_;
+    cv_space_.notify_one();
+    return true;
+  }
+
+  std::string error() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return error_;
+  }
+
+ private:
+  void Fail(const std::string& msg) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (error_.empty()) error_ = msg;
+    cv_done_.notify_all();
+    cv_task_.notify_all();
+  }
+
+  void ReaderMain() {
+    std::vector<unsigned char> page(kPageSize);
+    for (const auto& path : paths_) {
+      FILE* f = std::fopen(path.c_str(), "rb");
+      if (!f) {
+        Fail("cannot open " + path);
+        return;
+      }
+      while (!stop_.load()) {
+        size_t got = std::fread(page.data(), 1, kPageSize, f);
+        if (got == 0) break;
+        if (got < static_cast<size_t>(kPageSize)) {
+          std::fclose(f);
+          Fail("truncated page in " + path);
+          return;
+        }
+        const int32_t* ints = reinterpret_cast<const int32_t*>(page.data());
+        int32_t n = ints[0];
+        if (n < 0 || n + 2 > kPageNumInts) {
+          std::fclose(f);
+          Fail("corrupt page header in " + path);
+          return;
+        }
+        for (int32_t r = 0; r < n && !stop_.load(); ++r) {
+          int64_t start = ints[r + 1], end = ints[r + 2];
+          if (end < start || end > kPageSize) {
+            std::fclose(f);
+            Fail("corrupt blob offsets in " + path);
+            return;
+          }
+          std::vector<unsigned char> blob(
+              page.data() + kPageSize - end, page.data() + kPageSize - start);
+          std::unique_lock<std::mutex> lk(mu_);
+          cv_space_.wait(lk, [&] {
+            return stop_.load() ||
+                   static_cast<int>(tasks_.size() + done_.size()) +
+                           inflight_ < max_inflight_;
+          });
+          if (stop_.load()) {
+            std::fclose(f);
+            return;
+          }
+          tasks_.push_back(Task{next_seq_++, std::move(blob)});
+          cv_task_.notify_one();
+        }
+      }
+      std::fclose(f);
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    eof_ = true;
+    cv_task_.notify_all();
+    cv_done_.notify_all();
+  }
+
+  void WorkerMain() {
+    while (true) {
+      Task task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_task_.wait(lk, [&] {
+          return stop_.load() || !tasks_.empty() || eof_;
+        });
+        if (stop_.load()) return;
+        if (tasks_.empty()) {
+          if (eof_) return;
+          continue;
+        }
+        task = std::move(tasks_.front());
+        tasks_.pop_front();
+        ++inflight_;
+      }
+      Decoded d;
+      DecodeBlob(std::move(task.blob), &d);
+      if (float_chw_ && d.c > 0) ToChwFloat(&d);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        done_.emplace(task.seq, std::move(d));
+        --inflight_;
+        cv_done_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::string> paths_;
+  int n_threads_;
+  int max_inflight_;
+  bool float_chw_;
+
+  std::thread reader_;
+  std::vector<std::thread> workers_;
+  std::atomic<bool> stop_{true};
+
+  std::mutex mu_;
+  std::condition_variable cv_task_, cv_done_, cv_space_;
+  std::deque<Task> tasks_;
+  std::map<int64_t, Decoded> done_;
+  int inflight_ = 0;
+  int64_t next_seq_ = 0;
+  int64_t consume_seq_ = 0;
+  bool eof_ = false;
+  std::string error_;
+};
+
+struct Handle {
+  std::unique_ptr<Pipeline> pipe;
+  Decoded current;          // owns the buffer returned by cxio_next
+  std::string last_error;
+  std::vector<std::string> paths;
+  int n_threads = 4;
+  int max_inflight = 64;
+  bool float_chw = false;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+typedef struct {
+  const unsigned char* data;  // HWC u8 / CHW f32, or raw blob when c == 0
+  int h, w, c;                // c == 0: undecodable blob, byte length in w
+} CxioRecord;
+
+// float_chw != 0: records come back as CHW float32 (DataInst layout),
+// converted on the worker threads.
+void* cxio_open(const char* const* bin_paths, int n_bins, int n_threads,
+                int max_inflight, int float_chw) {
+  auto* h = new Handle();
+  for (int i = 0; i < n_bins; ++i) h->paths.emplace_back(bin_paths[i]);
+  if (n_threads > 0) h->n_threads = n_threads;
+  if (max_inflight > 0) h->max_inflight = max_inflight;
+  h->float_chw = float_chw != 0;
+  return h;
+}
+
+void cxio_before_first(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  h->pipe.reset(new Pipeline(h->paths, h->n_threads, h->max_inflight,
+                             h->float_chw));
+  h->pipe->Start();
+}
+
+int cxio_next(void* handle, CxioRecord* rec) {
+  auto* h = static_cast<Handle*>(handle);
+  if (!h->pipe) cxio_before_first(handle);
+  if (!h->pipe->Next(&h->current)) {
+    h->last_error = h->pipe->error();
+    return 0;
+  }
+  if (h->float_chw && h->current.c > 0) {
+    rec->data = reinterpret_cast<const unsigned char*>(
+        h->current.chw.data());
+  } else {
+    rec->data = h->current.pixels.data();
+  }
+  rec->h = h->current.h;
+  rec->w = h->current.w;
+  rec->c = h->current.c;
+  return 1;
+}
+
+const char* cxio_last_error(void* handle) {
+  return static_cast<Handle*>(handle)->last_error.c_str();
+}
+
+void cxio_close(void* handle) { delete static_cast<Handle*>(handle); }
+
+}  // extern "C"

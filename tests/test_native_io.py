@@ -1,0 +1,137 @@
+"""Native C++ io pipeline vs the pure-Python path.
+
+The library is built on demand from native/ (g++ + libjpeg + libpng are
+part of the toolchain); tests skip if the build is unavailable.
+"""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.io.native import NativeBinReader, native_available
+from cxxnet_tpu.tools.im2bin import im2bin
+from cxxnet_tpu.utils.config import parse_config_string
+
+from test_io import write_images
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native io library not built")
+
+
+def _make_bin(tmp_path, n=12, fmt="png"):
+    lst, root, labels = write_images(tmp_path, n=n)
+    if fmt == "jpeg":
+        from PIL import Image
+        import os
+        for i in range(n):
+            p = os.path.join(root, f"img_{i}.png")
+            Image.open(p).save(p, "JPEG", quality=95)  # same path, jpeg bytes
+    bin_path = str(tmp_path / "data.bin")
+    im2bin(lst, root, bin_path)
+    return lst, root, bin_path, labels
+
+
+def test_native_reader_png_matches_pil(tmp_path):
+    from cxxnet_tpu.io.iter_img import load_image_file
+    lst, root, bin_path, _ = _make_bin(tmp_path)
+    r = NativeBinReader([bin_path], n_threads=3)
+    r.before_first()
+    for i in range(12):
+        got = r.next()
+        expect = load_image_file(f"{root}img_{i}.png")
+        np.testing.assert_array_equal(got, expect)
+    assert r.next() is None
+    r.close()
+
+
+def test_native_reader_jpeg_decodes(tmp_path):
+    lst, root, bin_path, _ = _make_bin(tmp_path, fmt="jpeg")
+    r = NativeBinReader([bin_path], n_threads=2)
+    r.before_first()
+    count = 0
+    while True:
+        img = r.next()
+        if img is None:
+            break
+        assert img.shape == (3, 12, 12)
+        count += 1
+    assert count == 12
+    r.close()
+
+
+def test_native_reader_restart(tmp_path):
+    _, _, bin_path, _ = _make_bin(tmp_path, n=5)
+    r = NativeBinReader([bin_path])
+    for _ in range(3):
+        r.before_first()
+        seen = 0
+        while r.next() is not None:
+            seen += 1
+        assert seen == 5
+    r.close()
+
+
+def test_native_reader_multi_bin(tmp_path):
+    d1 = tmp_path / "a"
+    d2 = tmp_path / "b"
+    d1.mkdir()
+    d2.mkdir()
+    _, _, b1, _ = _make_bin(d1, n=3)
+    _, _, b2, _ = _make_bin(d2, n=4)
+    r = NativeBinReader([b1, b2])
+    r.before_first()
+    seen = 0
+    while r.next() is not None:
+        seen += 1
+    assert seen == 7
+    r.close()
+
+
+def test_native_reader_missing_file_errors(tmp_path):
+    r = NativeBinReader([str(tmp_path / "nope.bin")])
+    r.before_first()
+    with pytest.raises(IOError):
+        r.next()
+    r.close()
+
+
+def test_imgbin_native_matches_python(tmp_path):
+    """Full iterator chain: native decode == python decode, batch-exact."""
+    lst, root, bin_path, labels = _make_bin(tmp_path)
+    common = f"""
+image_list = "{lst}"
+image_bin = "{bin_path}"
+input_shape = 3,12,12
+batch_size = 4
+silent = 1
+"""
+    it_py = create_iterator(parse_config_string(
+        "iter = imgbin\nuse_native = 0" + common))
+    it_nat = create_iterator(parse_config_string(
+        "iter = imgbin\nuse_native = 1" + common))
+    it_py.init()
+    it_nat.init()
+    n = 0
+    for b1, b2 in zip(it_py, it_nat):
+        np.testing.assert_array_equal(b1.data, b2.data)
+        np.testing.assert_array_equal(b1.label, b2.label)
+        n += 1
+    assert n == 3
+
+
+def test_imgbin_native_shuffle_covers_all(tmp_path):
+    lst, root, bin_path, labels = _make_bin(tmp_path)
+    it = create_iterator(parse_config_string(f"""
+iter = imgbin
+use_native = 1
+shuffle = 1
+shuffle_buffer = 4
+image_list = "{lst}"
+image_bin = "{bin_path}"
+input_shape = 3,12,12
+batch_size = 4
+silent = 1
+"""))
+    it.init()
+    got = sorted(int(l) for b in it for l in b.label[:, 0])
+    assert got == sorted(int(x) for x in labels)
