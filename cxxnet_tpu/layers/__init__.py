@@ -6,6 +6,7 @@ from cxxnet_tpu.layers.base import (
 # importing the modules populates the registry
 from cxxnet_tpu.layers import common as _common  # noqa: F401
 from cxxnet_tpu.layers import loss as _loss  # noqa: F401
+from cxxnet_tpu.layers import pairtest as _pairtest  # noqa: F401
 from cxxnet_tpu.layers.loss import LossLayer
 
 __all__ = [

@@ -184,9 +184,17 @@ def create_layer(type_name: str, name: str = "") -> Layer:
     """Factory: config layer type string -> Layer instance.
 
     Mirrors GetLayerType (layer.h:322-361) + CreateLayer_
-    (layer_impl-inl.hpp:36-76). `share[...]` and `pairtest-...` are handled
-    by the net config / pairtest harness, not here.
+    (layer_impl-inl.hpp:36-76). `share[...]` is handled by the net config;
+    `pairtest-A-B` builds a differential-testing wrapper (layer.h:354-358).
     """
+    if type_name.startswith("pairtest-"):
+        from cxxnet_tpu.layers.pairtest import PairTestLayer
+        parts = type_name.split("-", 2)
+        if len(parts) != 3 or not parts[1] or not parts[2]:
+            raise ValueError(
+                f'unknown layer type: "{type_name}" '
+                "(pairtest syntax is pairtest-<master>-<slave>)")
+        return PairTestLayer(parts[1], parts[2], name)
     if type_name not in LAYER_REGISTRY:
         raise ValueError(f'unknown layer type: "{type_name}"')
     return LAYER_REGISTRY[type_name](name)

@@ -1,0 +1,94 @@
+"""PairTest differential harness (pairtest_layer-inl.hpp:15-203 parity)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu.layers import create_layer
+from cxxnet_tpu.layers.pairtest import PairTestLayer, run_pairtest
+
+TOL = 1e-4
+
+
+def _mk(type_name, params):
+    layer = create_layer(type_name, "pt")
+    for k, v in params.items():
+        layer.set_param(k, str(v))
+    return layer
+
+
+@pytest.mark.parametrize("conv_cfg", [
+    dict(nchannel=8, kernel_size=3, stride=1, pad=1),
+    dict(nchannel=8, kernel_size=5, stride=2, pad=0),
+    dict(nchannel=8, kernel_size=3, stride=1, pad=1, ngroup=2),
+])
+def test_conv_vs_im2col(conv_cfg):
+    """Production lax.conv vs the reference's own im2col-GEMM algorithm:
+    outputs, input grads, and weight grads must agree."""
+    layer = _mk("pairtest-conv-conv_im2col", conv_cfg)
+    assert isinstance(layer, PairTestLayer)
+    report = run_pairtest(layer, [(4, 4, 9, 9)])
+    assert set(report) == {"out[0]", "in_grad[0]", "wgrad/wmat",
+                           "wgrad/bias"}
+    for k, err in report.items():
+        assert err < TOL, (k, err, report)
+
+
+def test_pairtest_identical_impl_zero_err():
+    layer = _mk("pairtest-relu-relu", {})
+    report = run_pairtest(layer, [(2, 3, 5, 5)])
+    assert all(v == 0.0 for v in report.values()), report
+
+
+def test_master_slave_param_routing():
+    """`master:`/`slave:` prefixes route to one side only
+    (pairtest_layer-inl.hpp:128-137)."""
+    layer = _mk("pairtest-conv-conv_im2col",
+                dict(nchannel=4, kernel_size=3))
+    layer.set_param("master:stride", "2")
+    assert layer.master.param.stride == 2
+    assert layer.slave.param.stride == 1
+    layer.set_param("slave:stride", "2")
+    assert layer.slave.param.stride == 2
+
+
+def test_shape_mismatch_rejected():
+    layer = _mk("pairtest-conv-conv_im2col",
+                dict(nchannel=4, kernel_size=3))
+    layer.set_param("master:stride", "2")
+    with pytest.raises(ValueError, match="shape mismatch"):
+        layer.infer_shapes([(2, 3, 9, 9)])
+
+
+def test_pairtest_inside_network():
+    """pairtest-... works as a netconfig layer type; forward returns the
+    master path's values."""
+    from cxxnet_tpu.nnet.net_config import NetConfig
+    from cxxnet_tpu.nnet.network import Network
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    cfg_text = """
+netconfig=start
+layer[0->1] = pairtest-conv-conv_im2col:c1
+  kernel_size = 3
+  nchannel = 4
+  pad = 1
+  pairtest_print = 1
+layer[1->2] = flatten
+layer[2->3] = fullc:fc
+  nhidden = 10
+layer[3->3] = softmax
+netconfig=end
+input_shape = 3,8,8
+"""
+    cfg = NetConfig()
+    cfg.configure(parse_config_string(cfg_text))
+    net = Network(cfg, batch_size=2)
+    params = net.init_params(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    values, _ = net.forward(params, {0: x}, train=False)
+    out = np.asarray(values[cfg.num_nodes - 1])
+    assert out.shape == (2, 1, 1, 10)
+    np.testing.assert_allclose(out.reshape(2, 10).sum(axis=1), 1.0,
+                               rtol=1e-5)
