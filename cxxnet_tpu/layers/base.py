@@ -151,6 +151,11 @@ class Layer:
         (e.g. fullc: wmat->'wmat', bias->'bias'; prelu slope->'bias')."""
         return {}
 
+    def model_shard_dims(self) -> Dict[str, int]:
+        """Tensor-parallel rule: param name -> dim sharded over the
+        'model' mesh axis (parallel/sharding.py). {} = replicate all."""
+        return {}
+
     # --- compute ---------------------------------------------------------
     def apply(self, params: Params, inputs: List[jax.Array], *,
               train: bool, rng: Optional[jax.Array] = None,

@@ -56,6 +56,10 @@ class FullConnectLayer(Layer):
     def param_tags(self) -> Dict[str, str]:
         return {"wmat": "wmat", "bias": "bias"}
 
+    def model_shard_dims(self) -> Dict[str, int]:
+        # Megatron-style column parallelism: split the output features
+        return {"wmat": 0, "bias": 0}
+
     def apply(self, params, inputs, *, train, rng=None):
         x = inputs[0]
         b = x.shape[0]
@@ -171,6 +175,12 @@ class ConvolutionLayer(Layer):
 
     def param_tags(self) -> Dict[str, str]:
         return {"wmat": "wmat", "bias": "bias"}
+
+    def model_shard_dims(self) -> Dict[str, int]:
+        # split output channels over 'model'; shardings_for checks only
+        # O % axis_size, so shards may straddle group boundaries (legal
+        # HLO - GSPMD partitions the grouped conv accordingly)
+        return {"wmat": 0, "bias": 0}
 
     def apply(self, params, inputs, *, train, rng=None):
         p = self.param
@@ -438,6 +448,9 @@ class PReluLayer(Layer):
         # (prelu_layer-inl.hpp ApplyVisitor)
         return {"slope": "bias"}
 
+    def model_shard_dims(self) -> Dict[str, int]:
+        return {"slope": 0}
+
     def apply(self, params, inputs, *, train, rng=None):
         x = inputs[0]
         slope = params["slope"]
@@ -506,6 +519,9 @@ class BatchNormLayer(Layer):
 
     def param_tags(self) -> Dict[str, str]:
         return {"slope": "wmat", "bias": "bias"}
+
+    def model_shard_dims(self) -> Dict[str, int]:
+        return {"slope": 0, "bias": 0}
 
     def apply(self, params, inputs, *, train, rng=None):
         x = inputs[0]

@@ -132,6 +132,9 @@ class PairTestLayer(Layer):
     def param_tags(self) -> Dict[str, str]:
         return self.master.param_tags()
 
+    def model_shard_dims(self) -> Dict[str, int]:
+        return self.master.model_shard_dims()
+
     def apply(self, params, inputs, *, train, rng=None):
         m_out = self.master.apply(params, inputs, train=train, rng=rng)
         s_out = self.slave.apply(params, inputs, train=train, rng=rng)

@@ -39,6 +39,7 @@ from cxxnet_tpu.nnet.net_config import NetConfig
 from cxxnet_tpu.nnet.network import Network, param_key
 from cxxnet_tpu.parallel.mesh import (
     MeshSpec, build_mesh, parse_device_spec, parse_mesh_spec)
+from cxxnet_tpu.parallel.sharding import shardings_for
 from cxxnet_tpu.updater import UpdaterParam, create_updater
 from cxxnet_tpu.utils.metric import MetricSet
 
@@ -132,6 +133,9 @@ class NetTrainer:
                 print(f"node[{self.net_cfg.node_names[i]}].shape: "
                       f"{s[0]},{s[1]},{s[2]},{s[3]}")
         self.mesh = build_mesh(self.mesh_spec, self.batch_size)
+        # tensor-parallel parameter shardings over the 'model' mesh axis
+        # (all-replicated on a pure-data mesh - parallel/sharding.py)
+        self._pshard = shardings_for(self.mesh, self.net)
         self._resolve_eval_nodes()
         self._build_updaters()
         self._compile()
@@ -188,7 +192,9 @@ class NetTrainer:
             state["ustate"] = jax.tree.map(
                 lambda a: jnp.asarray(a), self._loaded_opt)
             self._loaded_opt = None
-        self.state = jax.device_put(state, self._replicated)
+        # prefix pytree: one sharding per weight covers its updater-state
+        # dict too; same tree drives the jitted steps' in/out_shardings
+        self.state = jax.device_put(state, self._state_shardings)
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -275,19 +281,27 @@ class NetTrainer:
                     if values[nid] is not None}
 
         rep, shd = self._replicated, self._batch_sharded
+        # ustate prefix tree: one sharding per weight, prefixing the inner
+        # updater-state dict ({m} / {m1,m2}); mirrors _init_state's filter
+        ustate_prefix = {
+            lk: {pn: self._pshard[lk][pn] for pn in d
+                 if pn in self._pshard.get(lk, {})}
+            for lk, d in self.updaters.items()}
         state_shardings = {
-            "params": rep, "ustate": rep, "accum": rep,
+            "params": self._pshard, "ustate": ustate_prefix,
+            "accum": self._pshard,
             "count": rep, "epoch": rep,
         }
+        self._state_shardings = state_shardings
         label_shardings = {
             f: shd for f in self.net_cfg.label_name_map}
         self._train_step = jax.jit(
             train_step,
             in_shardings=(state_shardings, shd, label_shardings, shd, rep),
-            out_shardings=(state_shardings, rep, rep),
+            out_shardings=(state_shardings, rep, shd),
             donate_argnums=(0,))
         self._eval_step = jax.jit(
-            eval_step, in_shardings=(rep, shd), out_shardings=rep)
+            eval_step, in_shardings=(self._pshard, shd), out_shardings=shd)
 
     # ------------------------------------------------------------------
     # training api
@@ -460,7 +474,7 @@ class NetTrainer:
         arr = np.asarray(weight, dtype=np.float32).reshape(cur.shape)
         params = self.state["params"]
         params[lk[0]][lk[1]] = jax.device_put(
-            jnp.asarray(arr), self._replicated)
+            jnp.asarray(arr), self._pshard[lk[0]][lk[1]])
         self.state["params"] = params
 
     def _weight_key(self, layer_name: str, tag: str) -> Tuple[str, str]:

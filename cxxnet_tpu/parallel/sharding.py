@@ -1,0 +1,83 @@
+"""Per-parameter sharding rules: tensor parallelism over the 'model' axis.
+
+The reference has no tensor parallelism (SURVEY.md par.2.7 - every device
+holds a full replica); this module is the TPU-native extension that makes
+`mesh = data:8,model:4` meaningful. The design follows the GSPMD recipe:
+annotate *parameter* shardings only, and let XLA propagate activation
+shardings and insert the collectives (all-gather on the fullc output
+feature dim, reduce-scatter/all-reduce on contractions) over ICI.
+
+Rules (each layer declares which dim of each param rides 'model' via
+`Layer.model_shard_dims()`):
+- fullc wmat (nhidden, nin): shard nhidden (Megatron column-parallel);
+  bias (nhidden,) likewise. The following layer's contraction makes XLA
+  all-gather or keep the sharding, whichever its cost model prefers.
+- conv wmat OIHW: shard O (out channels); bias likewise. Channel-wise
+  params downstream of a sharded conv (prelu slope, batch-norm
+  slope/bias) shard the same dim so no resharding is needed.
+- Any param whose shard dim is not divisible by the model-axis size is
+  replicated (falling back is always legal - GSPMD handles mixtures).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cxxnet_tpu.nnet.network import Network, param_key
+
+MODEL_AXIS = "model"
+
+
+def param_pspecs(net: Network, shapes=None) -> Dict[str, Dict[str, P]]:
+    """PartitionSpec per parameter; P() (replicated) unless the layer
+    declares a model-shard dim and the dim divides the axis size."""
+    if shapes is None:
+        shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
+    specs: Dict[str, Dict[str, P]] = {}
+    for idx, info in enumerate(net.cfg.layers):
+        if info.is_shared:
+            continue
+        lk = param_key(net.cfg, idx)
+        if lk not in shapes:
+            continue
+        dims = net.layer_objs[idx].model_shard_dims()
+        specs[lk] = {}
+        for pn, sd in shapes[lk].items():
+            d = dims.get(pn)
+            if d is None:
+                specs[lk][pn] = P()
+            else:
+                spec = [None] * len(sd.shape)
+                spec[d] = MODEL_AXIS
+                specs[lk][pn] = P(*spec)
+    return specs
+
+
+def shardings_for(mesh: Mesh,
+                  net: Network) -> Dict[str, Dict[str, NamedSharding]]:
+    """NamedSharding tree parallel to the params pytree (two levels).
+
+    Falls back to replication when 'model' is absent from the mesh or the
+    sharded dim does not divide the axis size.
+    """
+    have_model = MODEL_AXIS in mesh.axis_names
+    msize = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        MODEL_AXIS, 1)
+    shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
+    pspecs = param_pspecs(net, shapes)
+    out: Dict[str, Dict[str, NamedSharding]] = {}
+    for lk, d in pspecs.items():
+        out[lk] = {}
+        for pn, spec in d.items():
+            if (not have_model or msize == 1 or spec == P()):
+                out[lk][pn] = NamedSharding(mesh, P())
+                continue
+            dim = next(i for i, a in enumerate(spec) if a == MODEL_AXIS)
+            if shapes[lk][pn].shape[dim] % msize != 0:
+                out[lk][pn] = NamedSharding(mesh, P())
+            else:
+                out[lk][pn] = NamedSharding(mesh, spec)
+    return out
