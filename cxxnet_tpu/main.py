@@ -63,6 +63,15 @@ class LearnTask:
             if "=" in arg:
                 name, val = arg.split("=", 1)
                 self.set_param(name.strip(), val.strip())
+        if self.device.split(":")[0] == "cpu":
+            # honor `dev = cpu` before any backend is touched: skip
+            # accelerator-platform init entirely (matters when the TPU
+            # tunnel is absent/unreachable - the CLI must still work)
+            import jax
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                pass  # backend already initialized
         self.init()
         if not self.silent:
             print("initializing end, start working")

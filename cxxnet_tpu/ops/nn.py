@@ -51,6 +51,9 @@ def lrn(x, local_size: int, alpha: float, beta: float, knorm: float):
     (lrn_layer-inl.hpp:36-56: tmp_norm = chpool<sum>(x^2) * (alpha/n) + knorm,
     out = x * tmp_norm^(-beta)).
     """
+    from cxxnet_tpu.ops.pallas_lrn import lrn_pallas, use_pallas_lrn
+    if use_pallas_lrn(x):
+        return lrn_pallas(x, local_size, alpha, beta, knorm)
     sq = x * x
     pad_lo = local_size // 2
     pad_hi = local_size - pad_lo - 1

@@ -1,0 +1,49 @@
+"""Differential tests: pallas LRN kernel (interpret mode) vs the XLA
+reduce_window implementation - the pairtest discipline (SURVEY.md par.4.1)
+applied to the hand-written TPU kernel."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.ops.nn import lrn
+from cxxnet_tpu.ops.pallas_lrn import lrn_pallas, use_pallas_lrn
+
+
+@pytest.mark.parametrize("shape,n", [
+    ((2, 16, 7, 9), 5),
+    ((2, 8, 5, 5), 3),
+    ((1, 32, 3, 3), 7),
+    ((3, 8, 1, 1), 1),
+])
+def test_forward_matches_xla(shape, n):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    ref = lrn(x, n, 0.001, 0.75, 1.0)
+    got = lrn_pallas(x, n, 0.001, 0.75, 1.0, True)
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape,n", [((2, 16, 7, 9), 5), ((2, 8, 5, 5), 3)])
+def test_grad_matches_xla(shape, n):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    g = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    gr = jax.grad(lambda x: jnp.sum(lrn(x, n, 0.001, 0.75, 1.0) * g))(x)
+    gp = jax.grad(
+        lambda x: jnp.sum(lrn_pallas(x, n, 0.001, 0.75, 1.0, True) * g))(x)
+    np.testing.assert_allclose(gr, gp, rtol=1e-4, atol=1e-5)
+
+
+def test_eligibility_gate():
+    # CPU backend in tests -> never eligible; odd channel counts never
+    x32 = jnp.zeros((1, 96, 4, 4), jnp.float32)
+    assert not use_pallas_lrn(x32) or jax.default_backend() == "tpu"
+    x_odd = jnp.zeros((1, 7, 4, 4), jnp.float32)
+    from cxxnet_tpu.ops.pallas_lrn import _tile_ok
+    assert not _tile_ok(x_odd)
+    x_bf = jnp.zeros((1, 24, 4, 4), jnp.bfloat16)
+    assert not _tile_ok(x_bf)       # 24 % 16 != 0
+    assert _tile_ok(jnp.zeros((1, 32, 4, 4), jnp.bfloat16))

@@ -103,7 +103,6 @@ def test_tp_indivisible_falls_back_to_replication():
     tp3 = NetTrainer()
     for k, v in parse_config_string(CONV_NET.replace(
             "nhidden = 4", "nhidden = 5")):
-        t3_k, t3_v = k, v
         tp3.set_param(k, v)
     tp3.set_param("mesh", "data:2,model:4")
     tp3.init_model()
