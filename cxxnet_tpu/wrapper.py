@@ -135,6 +135,9 @@ class Net:
         return self._net.extract_feature(_batch_from_numpy(data, None),
                                          node_name)
 
+    def has_layer(self, layer_name: str) -> bool:
+        return layer_name in self._net.net_cfg.layer_name_map
+
     def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
         w, _ = self._net.get_weight(layer_name, tag)
         return w
