@@ -200,6 +200,10 @@ def create_layer(type_name: str, name: str = "") -> Layer:
                 f'unknown layer type: "{type_name}" '
                 "(pairtest syntax is pairtest-<master>-<slave>)")
         return PairTestLayer(parts[1], parts[2], name)
+    if type_name == "torch":
+        # plugin layers register on first use (the analog of the
+        # reference's compile-time CXXNET_USE_CAFFE_ADAPTOR gate)
+        import cxxnet_tpu.plugin.torch_adapter  # noqa: F401
     if type_name not in LAYER_REGISTRY:
         raise ValueError(f'unknown layer type: "{type_name}"')
     return LAYER_REGISTRY[type_name](name)

@@ -1,0 +1,2 @@
+"""Optional plugin layers (the role of src/plugin/ in the reference:
+external-framework adapters, off the hot path, enabled on demand)."""

@@ -1,0 +1,185 @@
+"""torch adapter plugin: drop a torch.nn.Module into a netconfig DAG.
+
+Role parity with the reference's caffe adapter
+(src/plugin/caffe_adapter-inl.hpp:27-231): wrap a layer from an external
+framework as a first-class DAG layer - inputs/outputs mirrored across the
+boundary, external params exposed to our updaters/checkpoints, gradients
+flowing through. Where the reference copies node data into caffe Blobs,
+here the torch module runs on host CPU under `jax.pure_callback`, with a
+`jax.custom_vjp` whose backward calls torch.autograd - so it composes
+with jit/grad like any pure-JAX layer (at host-callback speed; this is an
+escape hatch, not a hot path, exactly like the reference gates its
+adapter off by default - global.h:8-10).
+
+Config (quotes keep the tokenizer from splitting on spaces):
+    layer[a->b] = torch:mylayer
+      torch_module = "nn.Conv2d(3, 8, 3, padding=1)"
+
+The expression is evaluated with `torch` and `torch.nn as nn` in scope.
+Params are discovered from the module (named_parameters) and live in the
+regular params pytree (trained by OUR updaters; copied into the module
+around every callback).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.layers.base import Layer, Params, Shape, register_layer
+
+
+def _sanitize(name: str) -> str:
+    return name.replace(".", "_")
+
+
+@register_layer
+class TorchAdapterLayer(Layer):
+    """`torch`: wraps a torch.nn.Module built from the config string."""
+
+    type_name = "torch"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.module_expr = ""
+        self._module = None
+        self._param_names: List[str] = []
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "torch_module":
+            self.module_expr = val
+
+    # -- module construction ------------------------------------------------
+    def _build_module(self):
+        if self._module is not None:
+            return
+        if not self.module_expr:
+            raise ValueError(
+                "torch adapter: must set torch_module = <expression>")
+        try:
+            import torch
+            from torch import nn
+        except ImportError as e:  # pragma: no cover - torch is baked in
+            raise RuntimeError(
+                "torch adapter requires torch installed") from e
+        self._module = eval(self.module_expr,  # noqa: S307 - config-owned
+                            {"torch": torch, "nn": nn})
+        self._module = self._module.float().cpu()
+        self._param_names = [n for n, _ in
+                             self._module.named_parameters()]
+        bufs = [n for n, _ in self._module.named_buffers()]
+        if bufs:
+            import warnings
+            warnings.warn(
+                "torch adapter: module has stateful buffers "
+                f"{bufs}; they are neither trained nor checkpointed "
+                "(running stats will stay at their init values)",
+                stacklevel=2)
+
+    def _torch(self):
+        import torch
+        return torch
+
+    def _load_params(self, params: Dict[str, np.ndarray]) -> None:
+        torch = self._torch()
+        with torch.no_grad():
+            for n, p in self._module.named_parameters():
+                p.copy_(torch.from_numpy(
+                    np.asarray(params[_sanitize(n)], np.float32)))
+
+    # -- Layer protocol -----------------------------------------------------
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        self._build_module()
+        torch = self._torch()
+        with torch.no_grad():
+            out = self._module(torch.zeros(*in_shapes[0]))
+        if out.dim() != 4:
+            raise ValueError(
+                "torch adapter: module must map NCHW -> NCHW, got "
+                f"{tuple(out.shape)}")
+        self._out_shape_tail = tuple(out.shape)[1:]
+        return [tuple(out.shape)]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        self._build_module()
+        # torch's own initialization is the layer's init (the reference
+        # keeps caffe's blob init too)
+        return {
+            _sanitize(n): jnp.asarray(
+                p.detach().cpu().numpy().astype(np.float32))
+            for n, p in self._module.named_parameters()}
+
+    def param_tags(self) -> Dict[str, str]:
+        self._build_module()
+        tags = {}
+        for n, p in self._module.named_parameters():
+            tags[_sanitize(n)] = "bias" if p.dim() == 1 else "wmat"
+        return tags
+
+    def apply(self, params: Params, inputs: List[jax.Array], *,
+              train: bool, rng: Optional[jax.Array] = None,
+              ) -> List[jax.Array]:
+        self._build_module()
+        x = inputs[0]
+        names = [_sanitize(n) for n in self._param_names]
+        ptuple = tuple(params[n] for n in names)
+        out_shape = (x.shape[0],) + self._out_shape_tail
+        layer = self
+
+        def host_fwd(pvals, xv):
+            torch = layer._torch()
+            layer._load_params(dict(zip(names, pvals)))
+            layer._module.train(train)  # honor Dropout etc. semantics
+            with torch.no_grad():
+                out = layer._module(
+                    torch.from_numpy(np.asarray(xv, np.float32)))
+            return out.numpy().astype(np.float32)
+
+        def host_bwd(pvals, xv, gv):
+            torch = layer._torch()
+            layer._load_params(dict(zip(names, pvals)))
+            layer._module.train(train)
+            xt = torch.from_numpy(np.asarray(xv, np.float32))
+            xt.requires_grad_(True)
+            out = layer._module(xt)
+            tparams = [p for _, p in layer._module.named_parameters()]
+            grads = torch.autograd.grad(
+                out, [xt] + tparams,
+                grad_outputs=torch.from_numpy(
+                    np.asarray(gv, np.float32)),
+                allow_unused=True)
+            res = []
+            for g, ref in zip(grads, [xt] + tparams):
+                res.append(np.zeros(tuple(ref.shape), np.float32)
+                           if g is None else
+                           g.detach().numpy().astype(np.float32))
+            return tuple(res)
+
+        @jax.custom_vjp
+        def f(ptuple, x):
+            return jax.pure_callback(
+                host_fwd,
+                jax.ShapeDtypeStruct(out_shape, jnp.float32),
+                ptuple, x.astype(jnp.float32))
+
+        def f_fwd(ptuple, x):
+            return f(ptuple, x), (ptuple, x)
+
+        def f_bwd(res, g):
+            ptuple, x = res
+            outs = jax.pure_callback(
+                host_bwd,
+                tuple([jax.ShapeDtypeStruct(x.shape, jnp.float32)]
+                      + [jax.ShapeDtypeStruct(p.shape, jnp.float32)
+                         for p in ptuple]),
+                ptuple, x.astype(jnp.float32), g.astype(jnp.float32))
+            return tuple(outs[1:]), outs[0].astype(x.dtype)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(ptuple, x).astype(x.dtype)]

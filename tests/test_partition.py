@@ -1,0 +1,92 @@
+"""imgbin_partition tool: shard a .lst into N .lst/.bin partitions
+(parity with tools/imgbin-partition-maker.py)."""
+
+import os
+import subprocess
+
+import numpy as np
+
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.io.iter_img import parse_list_file
+from cxxnet_tpu.tools.imgbin_partition import (
+    make_partitions, partition_list)
+from cxxnet_tpu.utils.config import parse_config_string
+
+from tests.test_io import write_images
+
+
+def test_partition_modes():
+    entries = [(i, [float(i % 3)], f"f{i}.png") for i in range(10)]
+    cont = partition_list(entries, 3, "contiguous")
+    assert [len(p) for p in cont] == [4, 4, 2]
+    assert cont[0][0][0] == 0 and cont[1][0][0] == 4
+    rr = partition_list(entries, 3, "roundrobin")
+    assert [len(p) for p in rr] == [4, 3, 3]
+    assert [e[0] for e in rr[1]] == [1, 4, 7]
+    # all entries preserved exactly once
+    got = sorted(e[0] for p in rr for e in p)
+    assert got == list(range(10))
+
+
+def test_partition_pack_roundtrip(tmp_path):
+    lst, root, labels = write_images(tmp_path, n=10)
+    prefix = str(tmp_path / "part")
+    lsts = make_partitions(lst, root, prefix, 3, "contiguous", pack=True)
+    assert len(lsts) == 3
+    total = 0
+    for i, p in enumerate(lsts):
+        entries = parse_list_file(p)
+        total += len(entries)
+        assert os.path.exists(f"{prefix}.{i}.bin")
+        # each shard loads through the imgbin iterator
+        it = create_iterator(parse_config_string(f"""
+iter = imgbin
+image_list = "{p}"
+image_bin = "{prefix}.{i}.bin"
+input_shape = 3,12,12
+batch_size = 2
+round_batch = 1
+silent = 1
+"""))
+        it.init()
+        batches = list(it)
+        assert sum(b.batch_size - b.num_batch_padd
+                   for b in batches) == len(entries)
+    assert total == 10
+
+
+def test_partition_makefile(tmp_path):
+    lst, root, _ = write_images(tmp_path, n=6)
+    prefix = str(tmp_path / "mkpart")
+    make_partitions(lst, root, prefix, 2, "roundrobin", makefile=True)
+    mk = f"{prefix}.mk"
+    assert os.path.exists(mk)
+    # the generated makefile actually packs the shards
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(["make", "-f", mk, "-j", "2"], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert os.path.exists(f"{prefix}.0.bin")
+    assert os.path.exists(f"{prefix}.1.bin")
+
+
+def test_label_format_roundtrip(tmp_path):
+    # multi-label + float labels survive the lst rewrite
+    root = str(tmp_path) + "/"
+    lines = ["0\t1\t2.5\ta.png", "1\t0\t-3\tb.png"]
+    lst = str(tmp_path / "m.lst")
+    with open(lst, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    from cxxnet_tpu.tools.imgbin_partition import _write_lst
+    entries = parse_list_file(lst)
+    out = str(tmp_path / "out.lst")
+    _write_lst(out, entries)
+    back = parse_list_file(out)
+    assert len(back) == 2
+    for (i1, l1, f1), (i2, l2, f2) in zip(entries, back):
+        assert i1 == i2 and f1 == f2
+        np.testing.assert_allclose(l1, l2)

@@ -1,0 +1,106 @@
+"""torch adapter plugin (plugin/torch_adapter.py) - the caffe-adapter
+analog: an external torch.nn.Module as a DAG layer with params trained
+by our updaters and gradients through torch.autograd."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+NET = """
+netconfig=start
+layer[0->1] = torch:tconv
+  torch_module = "nn.Conv2d(3, 8, 3, padding=1)"
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:fc
+  nhidden = 4
+layer[4->4] = softmax
+netconfig=end
+input_shape = 3,6,6
+random_type = xavier
+eta = 0.2
+momentum = 0.9
+batch_size = 8
+silent = 1
+eval_train = 1
+metric = error
+"""
+
+
+def _trainer():
+    t = NetTrainer()
+    for k, v in parse_config_string(NET):
+        t.set_param(k, v)
+    t.init_model()
+    return t
+
+
+def test_forward_matches_torch():
+    t = _trainer()
+    x = np.random.RandomState(0).randn(8, 3, 6, 6).astype(np.float32)
+    out = t.extract_feature(DataBatch(
+        data=x, label=np.zeros((8, 1), np.float32)), "1")
+    # same conv in torch with the params our tree holds
+    params = jax.tree.map(np.asarray, t.state["params"])
+    m = torch.nn.Conv2d(3, 8, 3, padding=1)
+    with torch.no_grad():
+        m.weight.copy_(torch.from_numpy(params["tconv"]["weight"]))
+        m.bias.copy_(torch.from_numpy(params["tconv"]["bias"]))
+        expect = m(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out.reshape(expect.shape), expect,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_flow_and_training_learns():
+    t = _trainer()
+    rng = np.random.RandomState(3)
+    # separable: class = which input channel is lit
+    def batch():
+        lab = rng.randint(0, 3, size=8)
+        x = rng.randn(8, 3, 6, 6).astype(np.float32) * 0.1
+        for i, c in enumerate(lab):
+            x[i, c] += 1.0
+        return DataBatch(data=x, label=lab.reshape(-1, 1).astype(
+            np.float32))
+    before = jax.tree.map(np.asarray, t.state["params"])
+    for _ in range(30):
+        t.update(batch())
+    after = jax.tree.map(np.asarray, t.state["params"])
+    # torch conv weights moved -> grads flowed through the callback
+    assert not np.allclose(before["tconv"]["weight"],
+                           after["tconv"]["weight"])
+    err = float(t.eval_train_metric().split(":")[-1])
+    assert err < 0.2, f"train error {err}"
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import io
+    t = _trainer()
+    t.update(DataBatch(
+        data=np.random.RandomState(0).randn(8, 3, 6, 6).astype(
+            np.float32),
+        label=np.zeros((8, 1), np.float32)))
+    buf = io.BytesIO()
+    t.save_model(buf)
+    buf.seek(0)
+    t2 = NetTrainer()
+    for k, v in parse_config_string(NET):
+        t2.set_param(k, v)
+    t2.load_model(buf)
+    a = jax.tree.map(np.asarray, t.state["params"])
+    b = jax.tree.map(np.asarray, t2.state["params"])
+    np.testing.assert_allclose(a["tconv"]["weight"], b["tconv"]["weight"])
+
+
+def test_unknown_type_still_errors():
+    from cxxnet_tpu.layers import create_layer
+    with pytest.raises(ValueError, match="unknown layer type"):
+        create_layer("caffe2")
