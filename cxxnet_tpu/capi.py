@@ -123,11 +123,9 @@ def net_predict_iter(hid: int, iter_hid: int, oaddr: int, cap: int) -> int:
     net = _get(hid)
     it.before_first()
     while it.next():
-        p = net.predict(it)
-        padd = it.value.num_batch_padd
-        if padd:
-            p = p[:len(p) - padd]  # drop wrapped-around padding rows
-        preds.append(p)
+        # NetTrainer.predict already drops num_batch_padd rows (the
+        # valid-mask truncation in _forward_nodes)
+        preds.append(net.predict(it))
     out = np.concatenate(preds) if preds else np.zeros(0, np.float32)
     if out.size > cap:
         raise ValueError(f"output buffer too small: {out.size} > {cap}")

@@ -62,11 +62,21 @@ class MNISTIterator(DataIter):
             self.path_label = val
         if name == "seed_data":
             self.seed = int(val)
+        if name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        if name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
 
     def init(self) -> None:
         img = _read_idx_images(self.path_img).astype(np.float32) / 256.0
         labels = _read_idx_labels(self.path_label).astype(np.float32)
         inst = np.arange(len(labels), dtype=np.uint32) + self.inst_offset
+        nw = getattr(self, "dist_num_worker", 1)
+        if nw > 1:
+            # per-worker shard (reference sharding discipline,
+            # iter_thread_imbin-inl.hpp:189-220)
+            r = getattr(self, "dist_worker_rank", 0)
+            img, labels, inst = img[r::nw], labels[r::nw], inst[r::nw]
         if self.shuffle:
             rng = np.random.RandomState(self.seed)
             order = rng.permutation(len(labels))

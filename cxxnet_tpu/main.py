@@ -38,6 +38,7 @@ class LearnTask:
         self.name_model_dir = "models"
         self.num_round = 10
         self.test_io = 0
+        self.batch_size = 0
         self.silent = 0
         self.start_counter = 0
         self.max_round = 1 << 31
@@ -114,6 +115,8 @@ class LearnTask:
             self.device = val
         if name == "test_io":
             self.test_io = int(val)
+        if name == "batch_size":
+            self.batch_size = int(val)
         if name == "eval_train":
             self.eval_train = int(val)
         if name == "extract_node_name":
@@ -130,6 +133,11 @@ class LearnTask:
         return net
 
     def init(self) -> None:
+        # param_server=dist: join the multi-controller job up front so
+        # every later path (model load, iterators, mesh) sees the global
+        # device view (idempotent; trainer.init_model also calls it)
+        from cxxnet_tpu.parallel import distributed
+        distributed.init_from_config(self.cfg)
         if self.task == "train" and self.continue_training:
             if self._sync_latest_model():
                 print(f"Init: Continue training from round "
@@ -240,6 +248,18 @@ class LearnTask:
         def init_iter(it):
             for k, v in defcfg:
                 it.set_param(k, v)
+            # multi-controller: each worker feeds its local slice of the
+            # global batch from its own data shard (auto-wired unless the
+            # config sets dist_num_worker explicitly)
+            import jax
+            if jax.process_count() > 1:
+                it.set_param("batch_size", str(
+                    self.batch_size // jax.process_count()))
+                if not any(k == "dist_num_worker" for k, _ in self.cfg):
+                    it.set_param("dist_num_worker",
+                                 str(jax.process_count()))
+                    it.set_param("dist_worker_rank",
+                                 str(jax.process_index()))
             it.init()
 
         for it in filter(None, [self.itr_train, self.itr_pred]):

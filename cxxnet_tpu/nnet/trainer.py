@@ -37,6 +37,7 @@ from cxxnet_tpu.io.data import DataBatch
 from cxxnet_tpu.nnet import checkpoint
 from cxxnet_tpu.nnet.net_config import NetConfig
 from cxxnet_tpu.nnet.network import Network, param_key
+from cxxnet_tpu.parallel import distributed
 from cxxnet_tpu.parallel.mesh import (
     MeshSpec, build_mesh, parse_device_spec, parse_mesh_spec)
 from cxxnet_tpu.parallel.sharding import shardings_for
@@ -117,6 +118,10 @@ class NetTrainer:
     # initialization
     # ------------------------------------------------------------------
     def init_model(self) -> None:
+        # param_server=dist -> join the multi-controller job before any
+        # device is touched (replaces InitParamServer,
+        # nnet_impl-inl.hpp:376-390)
+        distributed.init_from_config(self.cfg_pairs)
         self.net_cfg.configure(self.cfg_pairs)
         self._build_net()
         key = jax.random.PRNGKey(self.seed)
@@ -194,7 +199,21 @@ class NetTrainer:
             self._loaded_opt = None
         # prefix pytree: one sharding per weight covers its updater-state
         # dict too; same tree drives the jitted steps' in/out_shardings
-        self.state = jax.device_put(state, self._state_shardings)
+        if jax.process_count() == 1:
+            self.state = jax.device_put(state, self._state_shardings)
+        else:
+            # multi-controller: assemble global arrays from the
+            # (identical) process-local values
+            full = self._expand_prefix(self._state_shardings, state)
+            self.state = jax.tree.map(distributed.put_global, state, full)
+
+    @staticmethod
+    def _expand_prefix(prefix, tree):
+        """Expand a sharding prefix pytree to a full per-leaf tree."""
+        return jax.tree.map(
+            lambda p, sub: jax.tree.map(lambda _: p, sub),
+            prefix, tree,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -312,14 +331,21 @@ class NetTrainer:
             if hasattr(layer, "anneal_step"):
                 layer.anneal_step()
 
+    @property
+    def _local_batch(self) -> int:
+        """Rows this process feeds (== batch_size when single-process;
+        batch_size/process_count under multi-controller SPMD, where the
+        per-worker iterators each carry their shard)."""
+        return distributed.local_batch_size(self.batch_size)
+
     def _pad_batch(self, batch: DataBatch):
-        """Pad a short batch up to batch_size (static shapes for XLA)."""
+        """Pad a short batch up to the local batch (static shapes)."""
         b = batch.batch_size
-        if b == self.batch_size:
+        if b == self._local_batch:
             return batch.data, batch.label, batch.valid_mask()
-        if b > self.batch_size:
+        if b > self._local_batch:
             raise ValueError("batch larger than configured batch_size")
-        pad = self.batch_size - b
+        pad = self._local_batch - b
         data = np.concatenate(
             [batch.data, np.zeros((pad,) + batch.data.shape[1:],
                                   batch.data.dtype)], axis=0)
@@ -337,16 +363,19 @@ class NetTrainer:
             jax.random.PRNGKey(self.seed + 100), self._step_counter)
         self._step_counter += 1
         labels = self._label_fields(label.astype(np.float32))
+        shd = self._batch_sharded
+        gdata = distributed.put_global(data.astype(np.float32), shd)
+        glabels = {k: distributed.put_global(v, shd)
+                   for k, v in labels.items()}
+        gmask = distributed.put_global(mask.astype(np.float32), shd)
         self.state, loss, outs = self._train_step(
-            self.state, data.astype(np.float32), labels,
-            mask.astype(np.float32), rng)
+            self.state, gdata, glabels, gmask, rng)
         if self.eval_train:
-            preds = [np.asarray(outs[nid]) for _, nid in self.eval_nodes]
+            preds = [distributed.fetch_local(outs[nid])
+                     for _, nid in self.eval_nodes]
             preds = [p.reshape(p.shape[0], -1) for p in preds]
-            self.train_metric.add_eval(preds, {
-                k: np.asarray(v) for k, v in labels.items()},
-                mask=np.asarray(mask) > 0)
-        self.epoch = int(self.state["epoch"])
+            self.train_metric.add_eval(preds, labels, mask=mask > 0)
+        self.epoch = int(distributed.fetch_local(self.state["epoch"]))
 
     def update_all(self, data_iter, eval_iters=None,
                    eval_names=None) -> None:
@@ -360,10 +389,12 @@ class NetTrainer:
     # ------------------------------------------------------------------
     def _forward_nodes(self, batch: DataBatch) -> Dict[int, np.ndarray]:
         data, _, mask = self._pad_batch(batch)
-        outs = self._eval_step(self.state["params"],
-                               data.astype(np.float32))
+        gdata = distributed.put_global(data.astype(np.float32),
+                                       self._batch_sharded)
+        outs = self._eval_step(self.state["params"], gdata)
         valid = int(mask.sum())
-        return {nid: np.asarray(v)[:valid] for nid, v in outs.items()}
+        return {nid: distributed.fetch_local(v)[:valid]
+                for nid, v in outs.items()}
 
     def evaluate(self, data_iter, data_name: str) -> str:
         """Run eval metrics over an iterator; returns the reference-format
@@ -416,10 +447,12 @@ class NetTrainer:
     # checkpoint api
     # ------------------------------------------------------------------
     def save_model(self, fo) -> None:
-        params = jax.tree.map(np.asarray, self.state["params"])
+        params = jax.tree.map(distributed.fetch_local,
+                              self.state["params"])
         opt = None
         if self.save_optimizer:
-            opt = jax.tree.map(np.asarray, self.state["ustate"])
+            opt = jax.tree.map(distributed.fetch_local,
+                               self.state["ustate"])
         checkpoint.save_model(fo, 0, self.net_cfg.to_dict(), self.epoch,
                               params, opt)
 
@@ -432,8 +465,8 @@ class NetTrainer:
         self._build_net()
         params = jax.tree.map(jnp.asarray, blob["params"])
         self._init_state(params)
-        self.state["epoch"] = jax.device_put(
-            jnp.asarray(self.epoch, jnp.int32), self._replicated)
+        self.state["epoch"] = distributed.put_global(
+            np.asarray(self.epoch, np.int32), self._replicated)
 
     def copy_model_from(self, fi) -> None:
         """Finetune: copy params of layers whose names match
@@ -441,7 +474,8 @@ class NetTrainer:
         if self.state is None:
             raise RuntimeError("copy_model_from requires init_model first")
         blob = checkpoint.load_model(fi)
-        params = jax.tree.map(np.asarray, self.state["params"])
+        params = jax.tree.map(distributed.fetch_local,
+                              self.state["params"])
         copied = []
         for lk, d in blob["params"].items():
             if lk.startswith("layer_"):
@@ -464,7 +498,7 @@ class NetTrainer:
         """Returns (2-D flattened weight, original shape); GetWeightVisitor
         flattening = (shape[0], prod(rest)) (visitor.h:26-100)."""
         lk = self._weight_key(layer_name, tag)
-        arr = np.asarray(self.state["params"][lk[0]][lk[1]])
+        arr = distributed.fetch_local(self.state["params"][lk[0]][lk[1]])
         return arr.reshape(arr.shape[0], -1), arr.shape
 
     def set_weight(self, weight: np.ndarray, layer_name: str,
@@ -473,9 +507,14 @@ class NetTrainer:
         cur = self.state["params"][lk[0]][lk[1]]
         arr = np.asarray(weight, dtype=np.float32).reshape(cur.shape)
         params = self.state["params"]
-        params[lk[0]][lk[1]] = jax.device_put(
-            jnp.asarray(arr), self._pshard[lk[0]][lk[1]])
+        params[lk[0]][lk[1]] = distributed.put_global(
+            arr, self._pshard[lk[0]][lk[1]])
         self.state["params"] = params
+
+    def check_weights(self) -> List[str]:
+        """test_on_server analog (async_updater-inl.hpp:144-153): verify
+        replicated params are identical on every device/process."""
+        return distributed.check_replicated(self.state["params"])
 
     def _weight_key(self, layer_name: str, tag: str) -> Tuple[str, str]:
         idx = self.net_cfg.get_layer_index(layer_name)

@@ -1,0 +1,178 @@
+"""Multi-host distributed runtime: the mshadow-ps "dist" replacement.
+
+The reference scales across machines with an async parameter server
+(mshadow-ps over ps-lite/ZMQ: bin/cxxnet.ps + nnet_ps_server.cpp,
+SURVEY.md par.2.7). The TPU-native equivalent is multi-controller SPMD:
+every host runs the SAME program under its own JAX process, the global
+device mesh spans all hosts, and gradient reduction is a synchronous XLA
+AllReduce over ICI/DCN inside the compiled step - no server processes,
+no push/pull, no worker/server distinction.
+
+Config surface parity:
+    param_server = dist          -> multi-controller mode
+    dist_coordinator = host:port -> coordinator (env CXN_COORDINATOR)
+    dist_num_worker = N          -> process count (env CXN_NUM_WORKER)
+    dist_worker_rank = i         -> this process   (env CXN_WORKER_RANK)
+and the data side reuses the reference's per-worker shard keys on the
+iterators (dist_num_worker/dist_worker_rank - iter_img.py, mirroring
+iter_thread_imbin-inl.hpp:189-220).
+
+`check_replicated` is the test_on_server/CheckWeight_ analog
+(async_updater-inl.hpp:144-153): verify that what should be identical
+on every device/process actually is.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+
+_initialized = False
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_workers: Optional[int] = None,
+                     rank: Optional[int] = None) -> None:
+    """Join the multi-controller job (idempotent).
+
+    Arguments fall back to CXN_COORDINATOR / CXN_NUM_WORKER /
+    CXN_WORKER_RANK env vars (the launcher sets them). Single-worker
+    jobs are a no-op, like the reference's local parameter server.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("CXN_COORDINATOR", "")
+    num_workers = num_workers if num_workers is not None else int(
+        os.environ.get("CXN_NUM_WORKER", "1"))
+    rank = rank if rank is not None else int(
+        os.environ.get("CXN_WORKER_RANK", "0"))
+    if num_workers <= 1:
+        return
+    if not coordinator:
+        raise ValueError(
+            "param_server=dist needs dist_coordinator (or "
+            "CXN_COORDINATOR) when dist_num_worker > 1")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_workers,
+                               process_id=rank)
+    _initialized = True
+
+
+def init_from_config(pairs: List[Tuple[str, str]]) -> None:
+    """Pull the dist_* keys out of a config pair list and initialize."""
+    cfg: Dict[str, str] = {}
+    for k, v in pairs:
+        cfg[k] = v
+    if cfg.get("param_server", "local") != "dist":
+        return
+    init_distributed(
+        coordinator=cfg.get("dist_coordinator"),
+        num_workers=int(cfg["dist_num_worker"])
+        if "dist_num_worker" in cfg else None,
+        rank=int(cfg["dist_worker_rank"])
+        if "dist_worker_rank" in cfg else None)
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def local_batch_size(global_batch: int) -> int:
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(
+            f"global batch_size {global_batch} must divide across "
+            f"{n} worker processes")
+    return global_batch // n
+
+
+# ---------------------------------------------------------------------------
+# global-array construction / host readback (multi-process safe)
+# ---------------------------------------------------------------------------
+
+def put_global(arr: np.ndarray, sharding) -> jax.Array:
+    """Host array -> global jax.Array under `sharding`.
+
+    Single process: plain device_put. Multi-process: `arr` is this
+    process's LOCAL slice for batch-sharded inputs (the iterator already
+    shards per worker), or the full identical value for replicated ones;
+    make_array_from_process_local_data assembles the global view.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, arr)
+
+
+def fetch_local(arr: jax.Array) -> np.ndarray:
+    """Global array -> this process's host view.
+
+    Fully-addressable arrays round-trip exactly. For multi-process
+    batch-sharded outputs the result is the concatenation of this
+    process's shards (rows of the local batch); replicated outputs
+    return the full value.
+    """
+    if arr.is_fully_addressable:
+        return np.asarray(arr)
+    if arr.sharding.is_fully_replicated:
+        return np.asarray(arr.addressable_data(0))
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# consistency checking (test_on_server analog)
+# ---------------------------------------------------------------------------
+
+def check_replicated(tree: Any, name: str = "params") -> List[str]:
+    """Verify replicated leaves are bit-identical on every local device
+    (and, across processes, that checksums agree). Returns a list of
+    human-readable mismatch descriptions; [] = consistent."""
+    bad: List[str] = []
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    sums = []
+    for path, leaf in leaves:
+        if not isinstance(leaf, jax.Array):
+            continue
+        if not leaf.sharding.is_fully_replicated:
+            continue  # sharded-by-design leaves have nothing to compare
+        shards = leaf.addressable_shards
+        base = np.asarray(shards[0].data)
+        for s in shards[1:]:
+            if not np.array_equal(base, np.asarray(s.data),
+                                  equal_nan=True):
+                bad.append(
+                    f"{name}{jax.tree_util.keystr(path)}: device "
+                    f"{s.device} diverges from {shards[0].device}")
+                break
+        sums.append(float(np.float64(np.abs(base).sum())))
+    if jax.process_count() > 1 and sums:
+        # gather every device's view of the checksums through one XLA
+        # all-gather over the global device list (same collective setup
+        # the train step itself uses)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mine = np.asarray(sums, np.float32)
+        devs = jax.devices()
+        mesh = Mesh(np.asarray(devs), ("dev",))
+        local = np.tile(mine[None, :], (len(jax.local_devices()), 1))
+        g = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dev")), local,
+            (len(devs), mine.size))
+        rep = jax.jit(lambda x: x,
+                      out_shardings=NamedSharding(mesh, P()))(g)
+        allv = np.asarray(rep.addressable_data(0))
+        for d in range(allv.shape[0]):
+            if not np.allclose(allv[d], mine, rtol=1e-6):
+                bad.append(
+                    f"{name}: device {devs[d]} checksums diverge from "
+                    f"process {jax.process_index()}")
+    return bad

@@ -72,7 +72,11 @@ def build_mesh(spec: MeshSpec, batch_size: int,
     else:
         idx = spec.device_indices
         if idx is None:
-            devices = devices[:1]
+            # single-controller default: one device. Multi-controller
+            # (param_server=dist): every process must own part of the
+            # mesh, so default to data-parallel over ALL global devices.
+            if jax.process_count() == 1:
+                devices = devices[:1]
         else:
             if max(idx) >= len(devices):
                 raise ValueError(
@@ -82,11 +86,19 @@ def build_mesh(spec: MeshSpec, batch_size: int,
         names = ["data"]
         sizes = [len(devices)]
 
-    # prune the data axis to divide the batch
+    # prune the data axis to divide the batch (single-controller only:
+    # under multi-controller SPMD, dropping devices would orphan some
+    # processes' chips, so an indivisible batch is an error instead)
     if "data" in names:
         di = names.index("data")
-        while batch_size % sizes[di] != 0:
-            sizes[di] -= 1
+        if jax.process_count() > 1:
+            if batch_size % sizes[di] != 0:
+                raise ValueError(
+                    f"batch_size {batch_size} must be divisible by the "
+                    f"data axis ({sizes[di]}) in multi-controller mode")
+        else:
+            while batch_size % sizes[di] != 0:
+                sizes[di] -= 1
 
     n = int(np.prod(sizes))
     if n > len(devices):

@@ -131,20 +131,29 @@ class TorchAdapterLayer(Layer):
         ptuple = tuple(params[n] for n in names)
         out_shape = (x.shape[0],) + self._out_shape_tail
         layer = self
+        # one torch-RNG seed shared by forward and backward, so a
+        # stochastic module (Dropout) draws the SAME mask in both - the
+        # backward re-runs the forward under torch.autograd
+        if rng is not None:
+            seed = jax.random.randint(rng, (), 0, np.int32(2**31 - 1))
+        else:
+            seed = jnp.zeros((), jnp.int32)
 
-        def host_fwd(pvals, xv):
+        def host_fwd(pvals, xv, sv):
             torch = layer._torch()
             layer._load_params(dict(zip(names, pvals)))
             layer._module.train(train)  # honor Dropout etc. semantics
+            torch.manual_seed(int(np.asarray(sv)))
             with torch.no_grad():
                 out = layer._module(
                     torch.from_numpy(np.asarray(xv, np.float32)))
             return out.numpy().astype(np.float32)
 
-        def host_bwd(pvals, xv, gv):
+        def host_bwd(pvals, xv, gv, sv):
             torch = layer._torch()
             layer._load_params(dict(zip(names, pvals)))
             layer._module.train(train)
+            torch.manual_seed(int(np.asarray(sv)))
             xt = torch.from_numpy(np.asarray(xv, np.float32))
             xt.requires_grad_(True)
             out = layer._module(xt)
@@ -162,24 +171,26 @@ class TorchAdapterLayer(Layer):
             return tuple(res)
 
         @jax.custom_vjp
-        def f(ptuple, x):
+        def f(ptuple, x, seed):
             return jax.pure_callback(
                 host_fwd,
                 jax.ShapeDtypeStruct(out_shape, jnp.float32),
-                ptuple, x.astype(jnp.float32))
+                ptuple, x.astype(jnp.float32), seed)
 
-        def f_fwd(ptuple, x):
-            return f(ptuple, x), (ptuple, x)
+        def f_fwd(ptuple, x, seed):
+            return f(ptuple, x, seed), (ptuple, x, seed)
 
         def f_bwd(res, g):
-            ptuple, x = res
+            ptuple, x, seed = res
             outs = jax.pure_callback(
                 host_bwd,
                 tuple([jax.ShapeDtypeStruct(x.shape, jnp.float32)]
                       + [jax.ShapeDtypeStruct(p.shape, jnp.float32)
                          for p in ptuple]),
-                ptuple, x.astype(jnp.float32), g.astype(jnp.float32))
-            return tuple(outs[1:]), outs[0].astype(x.dtype)
+                ptuple, x.astype(jnp.float32), g.astype(jnp.float32),
+                seed)
+            return (tuple(outs[1:]), outs[0].astype(x.dtype),
+                    jnp.zeros_like(seed))
 
         f.defvjp(f_fwd, f_bwd)
-        return [f(ptuple, x).astype(x.dtype)]
+        return [f(ptuple, x, seed).astype(x.dtype)]

@@ -44,7 +44,7 @@ def _write_lst(path: str,
                entries: List[Tuple[int, List[float], str]]) -> None:
     with open(path, "w", encoding="utf-8") as f:
         for idx, labels, fname in entries:
-            lab = "\t".join(f"{v:g}" for v in labels)
+            lab = "\t".join(repr(v) for v in labels)  # exact round-trip
             f.write(f"{idx}\t{lab}\t{fname}\n")
 
 

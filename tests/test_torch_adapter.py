@@ -100,6 +100,28 @@ def test_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(a["tconv"]["weight"], b["tconv"]["weight"])
 
 
+def test_stochastic_module_fwd_bwd_share_mask():
+    # Dropout: backward must see the SAME mask the forward drew, i.e.
+    # grad(sum(f(x))) == f(x)/x elementwise (both equal mask/keep)
+    from cxxnet_tpu.layers import create_layer
+    layer = create_layer("torch", "drop")
+    layer.set_param("torch_module", "nn.Dropout(0.5)")
+    layer.infer_shapes([(4, 2, 3, 3)])
+    x = jnp.asarray(
+        np.random.RandomState(0).rand(4, 2, 3, 3).astype(np.float32)
+        + 1.0)
+    rng = jax.random.PRNGKey(7)
+
+    def loss(x):
+        return jnp.sum(layer.apply({}, [x], train=True, rng=rng)[0])
+
+    out = layer.apply({}, [x], train=True, rng=rng)[0]
+    g = jax.grad(loss)(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(out / x),
+                               rtol=1e-5, atol=1e-6)
+    assert 0.0 < float((np.asarray(out) == 0).mean()) < 1.0
+
+
 def test_unknown_type_still_errors():
     from cxxnet_tpu.layers import create_layer
     with pytest.raises(ValueError, match="unknown layer type"):
