@@ -71,6 +71,7 @@ class NetTrainer:
         self._loaded_params = None
         self._loaded_opt = None
         self.save_optimizer = 0
+        self.model_format = "native"
         if dev:
             self.set_param("dev", dev)
         if cfg:
@@ -98,6 +99,10 @@ class NetTrainer:
             self.silent = int(val)
         if name == "save_optimizer":
             self.save_optimizer = int(val)
+        if name == "model_format":
+            if val not in ("native", "cxxnet"):
+                raise ValueError("model_format must be native or cxxnet")
+            self.model_format = val
         if name == "dtype":
             self.compute_dtype = {"float32": jnp.float32,
                                   "bfloat16": jnp.bfloat16}[val]
@@ -449,6 +454,12 @@ class NetTrainer:
     def save_model(self, fo) -> None:
         params = jax.tree.map(distributed.fetch_local,
                               self.state["params"])
+        if self.model_format == "cxxnet":
+            # reference-binary export (nnet/legacy_format.py)
+            from cxxnet_tpu.nnet import legacy_format
+            legacy_format.save_legacy_model(fo, self.net_cfg, self.net,
+                                            params, self.epoch)
+            return
         opt = None
         if self.save_optimizer:
             opt = jax.tree.map(distributed.fetch_local,
@@ -457,6 +468,13 @@ class NetTrainer:
                               params, opt)
 
     def load_model(self, fi) -> None:
+        # sniff the format: native files start with the CXTPU magic,
+        # reference-binary files with a little int32 net_type
+        head = fi.read(len(checkpoint.MAGIC))
+        fi.seek(-len(head), 1)
+        if head != checkpoint.MAGIC:
+            self._load_legacy(fi)
+            return
         blob = checkpoint.load_model(fi)
         self.net_cfg = NetConfig.from_dict(blob["net"])
         self.net_cfg.configure(self.cfg_pairs)
@@ -468,12 +486,37 @@ class NetTrainer:
         self.state["epoch"] = distributed.put_global(
             np.asarray(self.epoch, np.int32), self._replicated)
 
+    def _load_legacy(self, fi) -> None:
+        """Load a reference-binary model. Like the reference, the
+        netconfig must come from the config file; the file supplies
+        structure (validated for equality) + weights."""
+        from cxxnet_tpu.nnet import legacy_format
+        self.net_cfg = NetConfig()
+        self.net_cfg.configure(self.cfg_pairs)
+        self._build_net()
+        # shapes only - no throwaway device init
+        expected = jax.eval_shape(self.net.init_params,
+                                  jax.random.PRNGKey(self.seed))
+        blob = legacy_format.load_legacy_model(fi, self.net_cfg,
+                                               self.net, expected)
+        self.epoch = blob["epoch"]
+        params = jax.tree.map(jnp.asarray, blob["params"])
+        self._init_state(params)
+        self.state["epoch"] = distributed.put_global(
+            np.asarray(self.epoch, np.int32), self._replicated)
+
     def copy_model_from(self, fi) -> None:
         """Finetune: copy params of layers whose names match
         (nnet_impl-inl.hpp:101-134). Must be called after init_model."""
         if self.state is None:
             raise RuntimeError("copy_model_from requires init_model first")
-        blob = checkpoint.load_model(fi)
+        head = fi.read(len(checkpoint.MAGIC))
+        fi.seek(-len(head), 1)
+        if head == checkpoint.MAGIC:
+            blob = checkpoint.load_model(fi)
+        else:
+            from cxxnet_tpu.nnet import legacy_format
+            blob = legacy_format.read_legacy_model(fi)
         params = jax.tree.map(distributed.fetch_local,
                               self.state["params"])
         copied = []
@@ -482,8 +525,15 @@ class NetTrainer:
                 continue  # unnamed layers are not matched
             if lk in params:
                 for pn, arr in d.items():
-                    if (pn in params[lk]
-                            and params[lk][pn].shape == arr.shape):
+                    if pn not in params[lk]:
+                        continue
+                    want = params[lk][pn].shape
+                    if arr.shape != want and arr.size == params[
+                            lk][pn].size:
+                        # legacy conv wmat arrives in the file's 3D
+                        # layout - same memory order as our OIHW
+                        arr = arr.reshape(want)
+                    if arr.shape == want:
                         params[lk][pn] = arr
                 copied.append(lk)
         if not self.silent:

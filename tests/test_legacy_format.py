@@ -1,0 +1,173 @@
+"""Reference-binary checkpoint format (nnet/legacy_format.py): byte
+layout spot checks + round trips through the trainer (save cxxnet ->
+load auto-sniffed) on a net covering every weighted layer type."""
+
+import io
+import struct
+
+import numpy as np
+
+import jax
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+NET = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  ngroup = 2
+layer[1->2] = relu
+layer[2->3] = batch_norm:bn1
+layer[3->4] = prelu:pr1
+layer[4->5] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[5->6] = flatten
+layer[6->7] = fullc:fc1
+  nhidden = 12
+layer[7->7] = bias:bs1
+layer[7->8] = fullc:fc2
+  nhidden = 4
+  no_bias = 1
+layer[8->8] = softmax
+netconfig=end
+input_shape = 4,8,8
+random_type = gaussian
+eta = 0.1
+batch_size = 4
+silent = 1
+eval_train = 0
+"""
+
+
+def _trainer(extra=()):
+    t = NetTrainer()
+    for k, v in parse_config_string(NET):
+        t.set_param(k, v)
+    for k, v in extra:
+        t.set_param(k, v)
+    t.init_model()
+    return t
+
+
+def test_byte_layout():
+    t = _trainer([("model_format", "cxxnet")])
+    buf = io.BytesIO()
+    t.save_model(buf)
+    raw = buf.getvalue()
+    # int32 net_type = 0
+    assert struct.unpack_from("<i", raw, 0)[0] == 0
+    # NetParam: num_nodes, num_layers, input_shape (c,y,x)
+    nn, nl = struct.unpack_from("<ii", raw, 4)
+    assert nn == t.net_cfg.num_nodes and nl == t.net_cfg.num_layers
+    assert struct.unpack_from("<3I", raw, 12) == (4, 8, 8)
+    # NetParam is 152 bytes; first node name follows ("in")
+    (slen,) = struct.unpack_from("<Q", raw, 4 + 152)
+    name = raw[4 + 160: 4 + 160 + slen].decode()
+    assert name == "in"
+    # layer records: first layer is conv (enum 10)
+    off = 4 + 152
+    for _ in range(nn):
+        (n,) = struct.unpack_from("<Q", raw, off)
+        off += 8 + n
+    assert struct.unpack_from("<i", raw, off)[0] == 10
+
+
+def test_roundtrip_all_weighted_layers():
+    t = _trainer([("model_format", "cxxnet")])
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        t.update(DataBatch(
+            data=rng.randn(4, 4, 8, 8).astype(np.float32),
+            label=rng.randint(0, 4, size=(4, 1)).astype(np.float32)))
+    buf = io.BytesIO()
+    t.save_model(buf)
+    buf.seek(0)
+    t2 = NetTrainer()
+    for k, v in parse_config_string(NET):
+        t2.set_param(k, v)
+    t2.load_model(buf)  # auto-sniffed as legacy
+    a = jax.tree.map(np.asarray, t.state["params"])
+    b = jax.tree.map(np.asarray, t2.state["params"])
+    assert sorted(a) == sorted(b)
+    for lk in a:
+        for pn in a[lk]:
+            np.testing.assert_array_equal(a[lk][pn], b[lk][pn]), (lk, pn)
+    assert t2.epoch == t.epoch
+    # predictions identical
+    batch = DataBatch(
+        data=rng.randn(4, 4, 8, 8).astype(np.float32),
+        label=np.zeros((4, 1), np.float32))
+    np.testing.assert_array_equal(t.predict(batch), t2.predict(batch))
+
+
+def test_structure_mismatch_rejected():
+    t = _trainer([("model_format", "cxxnet")])
+    buf = io.BytesIO()
+    t.save_model(buf)
+    buf.seek(0)
+    other = NetTrainer()
+    for k, v in parse_config_string(
+            NET.replace("nhidden = 12", "nhidden = 16")):
+        other.set_param(k, v)
+    try:
+        other.load_model(buf)
+    except ValueError as e:
+        assert "shape" in str(e) or "mismatch" in str(e)
+    else:
+        raise AssertionError("mismatched structure must be rejected")
+
+
+def test_finetune_from_legacy_model():
+    t = _trainer([("model_format", "cxxnet")])
+    buf = io.BytesIO()
+    t.save_model(buf)
+    buf.seek(0)
+    # a different net that shares cv1/fc1 by name
+    other_cfg = NET.replace("nhidden = 4", "nhidden = 7")
+    t2 = NetTrainer()
+    for k, v in parse_config_string(other_cfg):
+        t2.set_param(k, v)
+    t2.init_model()
+    t2.copy_model_from(buf)
+    a = jax.tree.map(np.asarray, t.state["params"])
+    b = jax.tree.map(np.asarray, t2.state["params"])
+    np.testing.assert_array_equal(a["cv1"]["wmat"], b["cv1"]["wmat"])
+    np.testing.assert_array_equal(a["fc1"]["wmat"], b["fc1"]["wmat"])
+    np.testing.assert_array_equal(a["bn1"]["slope"], b["bn1"]["slope"])
+    assert b["fc2"]["wmat"].shape[0] == 7  # not copied (shape change)
+
+
+def test_torch_layer_rejected_in_legacy_format():
+    import pytest
+    # the torch plugin type has no reference encoding: exporting a net
+    # containing it must fail loudly, never silently drop its weights
+    cfg = NET.replace(
+        "layer[1->2] = relu",
+        'layer[1->2] = torch:tc1\n  torch_module = "nn.Conv2d(8,8,1)"')
+    t = NetTrainer()
+    for k, v in parse_config_string(cfg):
+        t.set_param(k, v)
+    t.set_param("model_format", "cxxnet")
+    t.init_model()
+    with pytest.raises(ValueError, match="no reference encoding"):
+        t.save_model(io.BytesIO())
+
+
+def test_native_format_still_roundtrips():
+    t = _trainer()
+    buf = io.BytesIO()
+    t.save_model(buf)
+    buf.seek(0)
+    t2 = NetTrainer()
+    for k, v in parse_config_string(NET):
+        t2.set_param(k, v)
+    t2.load_model(buf)
+    a = jax.tree.map(np.asarray, t.state["params"])
+    b = jax.tree.map(np.asarray, t2.state["params"])
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(x, y)
