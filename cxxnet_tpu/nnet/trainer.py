@@ -72,6 +72,10 @@ class NetTrainer:
         self._loaded_opt = None
         self.save_optimizer = 0
         self.model_format = "native"
+        self.profile = 0
+        self.profile_dir = ""
+        self._epoch_base = 0
+        self.profiler = None
         if dev:
             self.set_param("dev", dev)
         if cfg:
@@ -103,6 +107,11 @@ class NetTrainer:
             if val not in ("native", "cxxnet"):
                 raise ValueError("model_format must be native or cxxnet")
             self.model_format = val
+        if name == "profile":
+            self.profile = int(val)
+        if name == "profile_dir":
+            self.profile_dir = val
+            self.profile = max(self.profile, 1)
         if name == "dtype":
             self.compute_dtype = {"float32": jnp.float32,
                                   "bfloat16": jnp.bfloat16}[val]
@@ -133,6 +142,8 @@ class NetTrainer:
         params = self.net.init_params(key)
         self._init_state(params)
         self.epoch = 0
+        self._epoch_base = 0
+        self._step_counter = 0
 
     def _build_net(self) -> None:
         if self.batch_size <= 0:
@@ -149,6 +160,9 @@ class NetTrainer:
         self._resolve_eval_nodes()
         self._build_updaters()
         self._compile()
+        if self.profile and self.profiler is None:
+            from cxxnet_tpu.utils.profiler import StepProfiler
+            self.profiler = StepProfiler(self.profile_dir)
 
     def _resolve_eval_nodes(self) -> None:
         resolved = []
@@ -363,6 +377,8 @@ class NetTrainer:
 
     def update(self, batch: DataBatch) -> None:
         """One training mini-batch (CXXNetThreadTrainer::Update)."""
+        import time as _time
+        t0 = _time.perf_counter() if self.profile else 0.0
         data, label, mask = self._pad_batch(batch)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.seed + 100), self._step_counter)
@@ -380,7 +396,15 @@ class NetTrainer:
                      for _, nid in self.eval_nodes]
             preds = [p.reshape(p.shape[0], -1) for p in preds]
             self.train_metric.add_eval(preds, labels, mask=mask > 0)
-        self.epoch = int(distributed.fetch_local(self.state["epoch"]))
+        # host mirror of the device epoch counter (one update per
+        # update_period steps) - avoids forcing a device sync per step
+        self.epoch = self._epoch_base + (self._step_counter
+                                         // self.update_period)
+        if self.profile:
+            jax.block_until_ready(self.state["epoch"])
+            if self.profiler is not None:
+                self.profiler.add_step(_time.perf_counter() - t0,
+                                       batch.batch_size)
 
     def update_all(self, data_iter, eval_iters=None,
                    eval_names=None) -> None:
@@ -479,6 +503,8 @@ class NetTrainer:
         self.net_cfg = NetConfig.from_dict(blob["net"])
         self.net_cfg.configure(self.cfg_pairs)
         self.epoch = blob["epoch"]
+        self._epoch_base = self.epoch
+        self._step_counter = 0
         self._loaded_opt = blob["opt_state"]
         self._build_net()
         params = jax.tree.map(jnp.asarray, blob["params"])
@@ -500,6 +526,8 @@ class NetTrainer:
         blob = legacy_format.load_legacy_model(fi, self.net_cfg,
                                                self.net, expected)
         self.epoch = blob["epoch"]
+        self._epoch_base = self.epoch
+        self._step_counter = 0
         params = jax.tree.map(jnp.asarray, blob["params"])
         self._init_state(params)
         self.state["epoch"] = distributed.put_global(

@@ -1,0 +1,78 @@
+"""Step/pipeline profiler - an observability subsystem the reference
+lacks (SURVEY.md par.5: "no per-op timing, no profiler hooks"; it only
+prints wall-clock round times, cxxnet_main.cpp:376-387).
+
+Two levels:
+- `profile = 1`: per-round summaries of device step time vs host data
+  time (p50/p99/images-per-sec), printed to stderr next to the metrics.
+- `profile_dir = <path>`: additionally dumps an XLA/TensorBoard trace
+  via jax.profiler for the first profiled round (op-level timeline on
+  TPU; view with tensorboard or xprof).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class StepProfiler:
+    """Accumulates step + data timings for one round at a time."""
+
+    def __init__(self, trace_dir: str = ""):
+        self.trace_dir = trace_dir
+        self._tracing = False
+        self._traced_once = False
+        self.reset()
+
+    def reset(self) -> None:
+        self.step_s: List[float] = []
+        self.data_s: List[float] = []
+        self.examples = 0
+
+    # -- hooks -------------------------------------------------------------
+    def round_start(self) -> None:
+        self.reset()
+        if self.trace_dir and not self._traced_once:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+
+    def round_end(self) -> None:
+        if self._tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self._traced_once = True
+
+    def add_step(self, seconds: float, n_examples: int) -> None:
+        self.step_s.append(seconds)
+        self.examples += n_examples
+
+    def add_data(self, seconds: float) -> None:
+        self.data_s.append(seconds)
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> str:
+        if not self.step_s:
+            return "\tprofile: no steps"
+        s = np.asarray(self.step_s)
+        total = s.sum() + sum(self.data_s)
+        ips = self.examples / total if total > 0 else float("nan")
+        out = (f"\tprofile: {len(s)} steps, "
+               f"step p50 {np.percentile(s, 50) * 1e3:.2f} ms "
+               f"p99 {np.percentile(s, 99) * 1e3:.2f} ms, "
+               f"data {sum(self.data_s) * 1e3:.1f} ms total, "
+               f"{ips:.1f} images/sec")
+        if self.trace_dir:
+            out += f", trace -> {self.trace_dir}"
+        return out
+
+
+def timed(fn, *args, **kwargs):
+    """(result, seconds) of a host call."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    return out, time.perf_counter() - t0
