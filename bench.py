@@ -1,45 +1,59 @@
-"""Headline benchmark: AlexNet training throughput (images/sec) on the
-available accelerator, synthetic data (the reference publishes no
-quantitative baseline — BASELINE.md — so the driver-supplied target is
-per-chip A100 images/sec; A100_IMAGES_PER_SEC below is the comparison
-constant).
+"""Headline benchmark: AlexNet training throughput (images/sec).
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+Two numbers are measured on the same trainer:
+
+- ``compute``:  the jitted train step driven on pre-staged device
+  buffers - the kernel/compiler ceiling, what BENCH_r02 measured.
+- ``e2e``:      the full product path the reference times
+  (cxxnet_main.cpp:367-387): ``trainer.update()`` fed per-step from
+  host batches - includes padding, H2D staging, the on-device metric
+  accumulation, and the optimizer, i.e. what a user actually gets.
+
+The headline ``value`` is the END-TO-END number. Extra fields record the
+compute ceiling, the eval_train=1 variant, and the device topology so
+per-chip claims are verifiable from the artifact alone.
+
+Prints ONE JSON line even when the backend is unreachable
+(``{"metric": ..., "error": ...}``) - a backend hiccup must yield a
+diagnosable artifact, not rc=1.
+
+Baseline constant: the reference publishes no numbers (BASELINE.md), and
+this sandbox has no A100 (and no egress to cite one), so the A100
+anchor is an arithmetic estimate, documented at the constant.
+
+Usage: python bench.py [--profile DIR] [--steps N]
+    --profile DIR  additionally capture a jax.profiler trace of the
+                   steady-state e2e loop into DIR.
+
+A watchdog thread (CXN_BENCH_TIMEOUT, default 480 s) converts a hung
+backend (e.g. a stuck tunnel lease blocking inside PJRT client
+creation, where no Python signal can ever be delivered) into the error
+JSON line + clean exit instead of an rc-143 kill with no artifact.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
-# Approximate per-chip A100 AlexNet training throughput (batch 256,
-# synthetic data, mixed precision). The reference repo publishes no
-# numbers (BASELINE.md); this constant anchors vs_baseline at the
-# BASELINE.json target "≥90% of per-chip A100 images/sec".
+# AlexNet training flops/image ~= 0.72 GMAC fwd x 2 flop/MAC x 3
+# (fwd + dgrad + wgrad) ~= 4.3 GFLOP. A100 bf16 peak = 312 TFLOP/s;
+# AlexNet's LRN/pooling/fc mix sustains well under full MFU - assume
+# ~15%, in line with public convnet training MFU on Ampere, giving
+# 312e12 * 0.15 / 4.3e9 ~= 10.9k img/s; rounded to 10k. An estimate,
+# not a measurement: no A100 exists here and the reference publishes
+# no throughput numbers (BASELINE.md).
 A100_IMAGES_PER_SEC = 10000.0
 
 
-def main() -> int:
-    from __graft_entry__ import _ALEXNET_CONF, _make_trainer
-    from cxxnet_tpu.utils.config import parse_config_file
-
-    platform = jax.devices()[0].platform
-    # full headline config on an accelerator; shrunk on CPU so the
-    # harness stays runnable anywhere (still the same code path)
-    batch = 256 if platform != "cpu" else 16
-    steps = 50 if platform != "cpu" else 3
-    trainer = _make_trainer(
-        parse_config_file(_ALEXNET_CONF),
-        [("batch_size", str(batch)), ("dev", "tpu"), ("silent", "1"),
-         ("eval_train", "0"), ("save_model", "0")])
-
+def _measure_compute(trainer, batch, steps):
+    """Train-step-only throughput on pre-staged device buffers."""
+    import jax
     rng = np.random.RandomState(0)
     data = jax.device_put(
         rng.randn(batch, 3, 227, 227).astype(np.float32),
@@ -54,30 +68,162 @@ def main() -> int:
 
     state = trainer.state
     # warmup (compile + first run); the host readback of the loss forces
-    # true completion — block_until_ready alone does not flush the
+    # true completion - block_until_ready alone does not flush the
     # dispatch queue on tunneled platforms
     for i in range(3):
-        state, loss, _ = trainer._train_step(
+        state, loss = trainer._train_step(
             state, data, labels, mask, jax.random.fold_in(key, i))
     float(np.asarray(loss))
 
     t0 = time.perf_counter()
     for i in range(steps):
-        state, loss, _ = trainer._train_step(
+        state, loss = trainer._train_step(
             state, data, labels, mask, jax.random.fold_in(key, i))
     float(np.asarray(loss))
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
+    trainer.state = state
+    return steps * batch / dt
 
-    ips = steps * batch / dt
-    print(json.dumps({
-        "metric": "alexnet_b%d_%s_train" % (batch, platform),
-        "value": round(ips, 2),
+
+def _measure_e2e(trainer, batch, steps, profile_dir=""):
+    """Full trainer.update() path fed from host batches."""
+    import jax
+    from cxxnet_tpu.io.data import DataBatch
+    rng = np.random.RandomState(1)
+    # a few distinct host batches cycled through, like a RAM-resident
+    # iterator (membuffer); fresh numpy arrays each step would measure
+    # the RNG, identical ones would hide nothing - staging cost is the
+    # same either way
+    nbuf = min(8, steps)
+    batches = [DataBatch(
+        data=rng.randn(batch, 3, 227, 227).astype(np.float32),
+        label=rng.randint(0, 1000, (batch, 1)).astype(np.float32))
+        for _ in range(nbuf)]
+    for i in range(2):  # warmup
+        trainer.update(batches[i % nbuf])
+    jax.block_until_ready(trainer.state)
+
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        trainer.update(batches[i % nbuf])
+    jax.block_until_ready(trainer.state)
+    dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+    return steps * batch / dt
+
+
+def run(profile_dir="", steps_override=0) -> dict:
+    import jax
+    from __graft_entry__ import _ALEXNET_CONF, _make_trainer
+    from cxxnet_tpu.utils.config import parse_config_file
+
+    # an explicit JAX_PLATFORMS env must actually win: the tunnel's
+    # sitecustomize registers its plugin into every process, and plain
+    # jax.devices() would initialize it (and hang on a dead tunnel)
+    # even when the env asks for cpu
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except RuntimeError:
+            pass  # backend already initialized
+    # backend init is the one step that touches the (possibly tunneled)
+    # platform - retry transient failures instead of dying rc=1
+    last = None
+    for attempt in range(3):
+        try:
+            devices = jax.devices()
+            break
+        except Exception as e:  # noqa: BLE001 - backend errors vary
+            last = e
+            time.sleep(5.0 * (attempt + 1))
+    else:
+        raise RuntimeError(f"jax backend unreachable: {last}")
+    platform = devices[0].platform
+    ndev = len(devices)
+
+    # full headline config on an accelerator; shrunk on CPU so the
+    # harness stays runnable anywhere (still the same code path -
+    # AlexNet b256 on a host CPU would take tens of minutes)
+    batch = 256 if platform != "cpu" else 8
+    steps = steps_override or (50 if platform != "cpu" else 2)
+
+    def make(eval_train):
+        return _make_trainer(
+            parse_config_file(_ALEXNET_CONF),
+            [("batch_size", str(batch)), ("dev", "tpu"), ("silent", "1"),
+             ("eval_train", str(eval_train)), ("save_model", "0")])
+
+    trainer = make(0)
+    compute_ips = _measure_compute(trainer, batch, steps)
+    e2e_ips = _measure_e2e(trainer, batch, steps, profile_dir)
+    # eval_train=1 (the reference's default mode): the conf's metric
+    # lines (error, rec@1, rec@5) compile into the step as device-side
+    # accumulators
+    trainer_m = make(1)
+    e2e_metric_ips = _measure_e2e(trainer_m, batch, steps)
+
+    return {
+        "metric": "alexnet_b%d_%s_train_e2e" % (batch, platform),
+        "value": round(e2e_ips, 2),
         "unit": "images/sec",
-        "vs_baseline": round(ips / A100_IMAGES_PER_SEC, 4),
-    }))
+        "vs_baseline": round(e2e_ips / A100_IMAGES_PER_SEC, 4),
+        "compute_ips": round(compute_ips, 2),
+        "e2e_eval_train_ips": round(e2e_metric_ips, 2),
+        "e2e_over_compute": round(e2e_ips / compute_ips, 4),
+        "platform": platform,
+        "device_count": ndev,
+        "per_device_batch": batch // ndev,
+        "steps": steps,
+    }
+
+
+def _error_json(msg: str) -> str:
+    return json.dumps({"metric": "alexnet_train_e2e", "value": 0.0,
+                       "unit": "images/sec", "vs_baseline": 0.0,
+                       "error": msg})
+
+
+def main(argv) -> int:
+    try:
+        profile_dir = ""
+        steps = 0
+        if "--profile" in argv:
+            profile_dir = argv[argv.index("--profile") + 1]
+        if "--steps" in argv:
+            steps = int(argv[argv.index("--steps") + 1])
+        budget = int(os.environ.get("CXN_BENCH_TIMEOUT", "480"))
+    except Exception as e:  # noqa: BLE001 - the JSON line is the contract
+        print(_error_json(f"bad arguments {argv}: {e}"))
+        return 0
+
+    def watchdog():
+        # a hung PJRT client creation blocks in C with the GIL state
+        # such that signals never run - a plain daemon thread + _exit is
+        # the only reliable escape that still prints the artifact
+        print(_error_json(f"benchmark exceeded {budget}s "
+                          "(hung backend / stuck tunnel?)"), flush=True)
+        os._exit(0)
+
+    if budget > 0:
+        t = threading.Timer(budget, watchdog)
+        t.daemon = True
+        t.start()
+    try:
+        out = run(profile_dir, steps)
+    except BaseException as e:  # noqa: BLE001 - always emit the JSON line
+        print(_error_json(f"{type(e).__name__}: {e}"))
+        return 0
+    finally:
+        if budget > 0:
+            t.cancel()
+    print(json.dumps(out))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -144,6 +144,40 @@ class ImageAugmenter:
         return out
 
 
+def load_mean_image(path: str) -> np.ndarray:
+    """Load a mean image, auto-detecting the format.
+
+    Reference files are mshadow Tensor<cpu,3>::LoadBinary payloads
+    (iter_augment_proc-inl.hpp:84): uint32 shape[3] = (c, y, x) followed
+    by packed little-endian float32 data - the same SaveBinary layout the
+    checkpoint weights use (nnet/legacy_format.py). Files written by
+    earlier rounds of this repo are .npy; sniffed by the numpy magic.
+    """
+    with open(path, "rb") as fi:
+        head = fi.read(6)
+        fi.seek(0)
+        if head == b"\x93NUMPY":
+            return np.load(fi)
+        shape = np.frombuffer(fi.read(12), "<u4")
+        n = int(shape.prod())
+        data = np.frombuffer(fi.read(4 * n), "<f4")
+        if data.size != n:
+            raise ValueError(
+                f"{path}: truncated mean image (expected {n} floats)")
+        return data.reshape(tuple(int(s) for s in shape)).copy()
+
+
+def save_mean_image(path: str, mean: np.ndarray) -> None:
+    """Write the reference SaveBinary layout
+    (iter_augment_proc-inl.hpp:193) so reference binaries can consume
+    the file."""
+    if mean.ndim != 3:
+        raise ValueError("mean image must be (c, y, x)")
+    with open(path, "wb") as fo:
+        fo.write(np.asarray(mean.shape, "<u4").tobytes())
+        fo.write(np.ascontiguousarray(mean, "<f4").tobytes())
+
+
 class AugmentIterator(DataIter):
     """Crop/mirror/scale/mean pipeline over a DataInst iterator."""
 
@@ -206,7 +240,7 @@ class AugmentIterator(DataIter):
             if os.path.exists(self.name_meanimg):
                 if not self.silent:
                     print(f"loading mean image from {self.name_meanimg}")
-                self.meanimg = np.load(self.name_meanimg)
+                self.meanimg = load_mean_image(self.name_meanimg)
             else:
                 self._create_mean_img()
 
@@ -297,10 +331,6 @@ class AugmentIterator(DataIter):
             acc += x
             cnt += 1
         mean = (acc / max(cnt, 1)).astype(np.float32)
-        # np.save appends .npy to extension-less names; keep the exact
-        # configured filename so the cache-lookup in init() finds it
-        np.save(self.name_meanimg, mean)
-        if not os.path.exists(self.name_meanimg):
-            os.rename(self.name_meanimg + ".npy", self.name_meanimg)
+        save_mean_image(self.name_meanimg, mean)
         self.meanimg = mean
         self.base.before_first()

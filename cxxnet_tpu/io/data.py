@@ -3,8 +3,12 @@
 Parity with src/io/data.h:41-181: a batch carries CPU tensors
 data (b,c,h,w) and label (b,label_width), the instance indices, the count
 of padding rows in a final short batch (num_batch_padd), and optional
-extra-data tensors. All arrays are numpy (host); the trainer moves them
-to device inside the jitted step.
+extra-data tensors. A batch may instead carry a sparse CSR view
+(data.h:96-181: sparse_row_ptr + (findex, fvalue) entries); numpy-style,
+the Entry array-of-structs is split into parallel index/value arrays.
+All arrays are numpy (host); the trainer moves them to device inside the
+jitted step - sparse batches densify first (TPU compute wants static
+dense shapes; `to_dense`).
 """
 
 from __future__ import annotations
@@ -25,17 +29,70 @@ class DataInst:
 
 
 @dataclass
+class SparseInst:
+    """One row of a sparse batch (data.h:51-72)."""
+    index: int
+    label: np.ndarray            # (label_width,)
+    findex: np.ndarray           # (nnz,) uint32 feature indices
+    fvalue: np.ndarray           # (nnz,) float32 feature values
+
+    @property
+    def length(self) -> int:
+        return int(self.findex.shape[0])
+
+
+@dataclass
 class DataBatch:
     """Batch of instances (data.h:79-181)."""
-    data: np.ndarray                       # (b, c, h, w) float32
-    label: np.ndarray                      # (b, label_width) float32
+    data: Optional[np.ndarray] = None      # (b, c, h, w) float32
+    label: np.ndarray = None               # (b, label_width) float32
     inst_index: Optional[np.ndarray] = None  # (b,) uint32
     num_batch_padd: int = 0
     extra_data: List[np.ndarray] = field(default_factory=list)
+    # sparse CSR view (data.h:96-100): row_ptr[b+1]; parallel
+    # entry arrays instead of the reference's Entry struct array
+    sparse_row_ptr: Optional[np.ndarray] = None   # (b+1,) int64
+    sparse_findex: Optional[np.ndarray] = None    # (nnz,) uint32
+    sparse_fvalue: Optional[np.ndarray] = None    # (nnz,) float32
 
     @property
     def batch_size(self) -> int:
-        return int(self.data.shape[0])
+        if self.data is not None:
+            return int(self.data.shape[0])
+        return int(self.sparse_row_ptr.shape[0]) - 1
+
+    def is_sparse(self) -> bool:
+        """data.h:166-168."""
+        return self.sparse_row_ptr is not None
+
+    def get_row_sparse(self, rid: int) -> SparseInst:
+        """rid'th row of the sparse view (data.h:169-180)."""
+        if not self.is_sparse():
+            raise ValueError("GetRowSparse on a dense batch")
+        a, b = int(self.sparse_row_ptr[rid]), int(
+            self.sparse_row_ptr[rid + 1])
+        return SparseInst(
+            index=int(self.inst_index[rid])
+            if self.inst_index is not None else 0,
+            label=self.label[rid],
+            findex=self.sparse_findex[a:b],
+            fvalue=self.sparse_fvalue[a:b])
+
+    def to_dense(self, num_features: int) -> np.ndarray:
+        """Densify the CSR view to (b, 1, 1, num_features) float32 - the
+        shape the (static-shape, MXU-friendly) jitted step consumes.
+        Out-of-range feature indices are dropped, matching a fixed
+        input_shape contract."""
+        if not self.is_sparse():
+            raise ValueError("to_dense on a dense batch")
+        b = self.batch_size
+        out = np.zeros((b, num_features), np.float32)
+        ptr = self.sparse_row_ptr
+        rows = np.repeat(np.arange(b), np.diff(ptr))
+        cols = self.sparse_findex.astype(np.int64)
+        keep = cols < num_features
+        out[rows[keep], cols[keep]] = self.sparse_fvalue[keep]
+        return out.reshape(b, 1, 1, num_features)
 
     def valid_mask(self) -> np.ndarray:
         """(b,) float mask zeroing the trailing padding rows."""

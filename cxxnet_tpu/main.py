@@ -51,6 +51,7 @@ class LearnTask:
         self.output_format = 1
         self.device = "tpu"
         self.eval_train = 1
+        self.test_on_server = 0
         self.cfg: List[Tuple[str, str]] = []
 
     # ------------------------------------------------------------------
@@ -119,6 +120,8 @@ class LearnTask:
             self.batch_size = int(val)
         if name == "eval_train":
             self.eval_train = int(val)
+        if name == "test_on_server":
+            self.test_on_server = int(val)
         if name == "extract_node_name":
             self.extract_node_name = val
         if name == "output_format":
@@ -298,6 +301,15 @@ class LearnTask:
                     elapsed = int(time.time() - start)
                     print(f"round {self.start_counter - 1:8d}:"
                           f"[{sample_counter:8d}] {elapsed} sec elapsed")
+            if self.test_on_server:
+                # CheckWeight_ analog (async_updater-inl.hpp:144-153):
+                # every round, verify that replicated weights really are
+                # identical on every device/process; abort on divergence
+                bad = self.net_trainer.check_weights()
+                if bad:
+                    raise RuntimeError(
+                        "test_on_server: weight consistency check "
+                        "failed:\n" + "\n".join(bad))
             if self.test_io == 0:
                 sys.stderr.write(f"[{self.start_counter}]")
                 if self.eval_train:

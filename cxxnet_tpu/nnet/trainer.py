@@ -71,6 +71,7 @@ class NetTrainer:
         self._loaded_params = None
         self._loaded_opt = None
         self.save_optimizer = 0
+        self.shard_optimizer = 0
         self.model_format = "native"
         self.profile = 0
         self.profile_dir = ""
@@ -103,6 +104,8 @@ class NetTrainer:
             self.silent = int(val)
         if name == "save_optimizer":
             self.save_optimizer = int(val)
+        if name == "shard_optimizer":
+            self.shard_optimizer = int(val)
         if name == "model_format":
             if val not in ("native", "cxxnet"):
                 raise ValueError("model_format must be native or cxxnet")
@@ -211,6 +214,9 @@ class NetTrainer:
             "accum": accum,
             "count": jnp.zeros((), jnp.int32),
             "epoch": jnp.asarray(self.epoch, jnp.int32),
+            # on-device train-metric accumulator: one (sum, count) row per
+            # configured metric (utils/metric_jit.py)
+            "tmetric": jnp.zeros((len(self.train_metric), 2), jnp.float32),
         }
         if self._loaded_opt is not None:
             state["ustate"] = jax.tree.map(
@@ -221,10 +227,12 @@ class NetTrainer:
         if jax.process_count() == 1:
             self.state = jax.device_put(state, self._state_shardings)
         else:
-            # multi-controller: assemble global arrays from the
-            # (identical) process-local values
+            # multi-controller: every process holds the full value of
+            # each state leaf; put_global_full materializes only the
+            # locally-owned shards (handles sharded optimizer state)
             full = self._expand_prefix(self._state_shardings, state)
-            self.state = jax.tree.map(distributed.put_global, state, full)
+            self.state = jax.tree.map(distributed.put_global_full, state,
+                                      full)
 
     @staticmethod
     def _expand_prefix(prefix, tree):
@@ -265,12 +273,24 @@ class NetTrainer:
         scale = 1.0 / (self.batch_size * self.update_period)
         update_period = self.update_period
         updaters = self.updaters
+        # train metrics accumulate on device inside the step (the
+        # reference computes them from the same forward pass,
+        # nnet_impl-inl.hpp:174-180; a per-step host readback here would
+        # serialize the device - metric_jit.py)
+        from cxxnet_tpu.utils import metric_jit
+        metric_specs = self.train_metric.specs
+        metric_fns = [metric_jit.create_step_fn(name)
+                      for name, _ in metric_specs]
+        eval_train = bool(self.eval_train and metric_specs)
+
+        from cxxnet_tpu.parallel.mesh import active_mesh
 
         def loss_fn(params, data, labels, mask, rng):
             cparams = self._cast(params)
-            values, loss = net.forward(
-                cparams, {0: self._cast(data)}, train=True, rng=rng,
-                labels=labels, mask=mask)
+            with active_mesh(self.mesh):
+                values, loss = net.forward(
+                    cparams, {0: self._cast(data)}, train=True, rng=rng,
+                    labels=labels, mask=mask)
             outs = {nid: values[nid].astype(jnp.float32)
                     for nid in eval_node_ids}
             return loss.astype(jnp.float32) * scale, outs
@@ -301,19 +321,31 @@ class NetTrainer:
             params, ustate, accum = lax.cond(
                 do_update, apply_updates, lambda a: a,
                 (state["params"], state["ustate"], accum))
+            tmetric = state["tmetric"]
+            if eval_train:
+                rows = []
+                for i, ((_, field), fn, (_, nid)) in enumerate(
+                        zip(metric_specs, metric_fns, self.eval_nodes)):
+                    pred = outs[nid].reshape(outs[nid].shape[0], -1)
+                    s, c = fn(pred, labels[field], mask,
+                              jax.random.fold_in(rng, 1000 + i))
+                    rows.append(jnp.stack([s, c]))
+                tmetric = tmetric + jnp.stack(rows)
             new_state = {
                 "params": params,
                 "ustate": ustate,
                 "accum": accum,
                 "count": jnp.where(do_update, 0, count),
                 "epoch": state["epoch"] + do_update.astype(jnp.int32),
+                "tmetric": tmetric,
             }
-            return new_state, loss, outs
+            return new_state, loss
 
         def eval_step(params, data):
             cparams = self._cast(params)
-            values, _ = net.forward(cparams, {0: self._cast(data)},
-                                    train=False)
+            with active_mesh(self.mesh):
+                values, _ = net.forward(cparams, {0: self._cast(data)},
+                                        train=False)
             return {nid: values[nid].astype(jnp.float32)
                     for nid in range(net.cfg.num_nodes)
                     if values[nid] is not None}
@@ -321,14 +353,21 @@ class NetTrainer:
         rep, shd = self._replicated, self._batch_sharded
         # ustate prefix tree: one sharding per weight, prefixing the inner
         # updater-state dict ({m} / {m1,m2}); mirrors _init_state's filter
+        ushard = self._pshard
+        if self.shard_optimizer:
+            # ZeRO-1 / update_on_server analog: optimizer state sharded
+            # over 'data' (parallel/sharding.py:zero1_shardings)
+            from cxxnet_tpu.parallel.sharding import zero1_shardings
+            ushard = zero1_shardings(self.mesh, self.net, self._pshard)
         ustate_prefix = {
-            lk: {pn: self._pshard[lk][pn] for pn in d
-                 if pn in self._pshard.get(lk, {})}
+            lk: {pn: ushard[lk][pn] for pn in d
+                 if pn in ushard.get(lk, {})}
             for lk, d in self.updaters.items()}
+        self._ustate_shard = ustate_prefix
         state_shardings = {
             "params": self._pshard, "ustate": ustate_prefix,
             "accum": self._pshard,
-            "count": rep, "epoch": rep,
+            "count": rep, "epoch": rep, "tmetric": rep,
         }
         self._state_shardings = state_shardings
         label_shardings = {
@@ -336,7 +375,7 @@ class NetTrainer:
         self._train_step = jax.jit(
             train_step,
             in_shardings=(state_shardings, shd, label_shardings, shd, rep),
-            out_shardings=(state_shardings, rep, shd),
+            out_shardings=(state_shardings, rep),
             donate_argnums=(0,))
         self._eval_step = jax.jit(
             eval_step, in_shardings=(self._pshard, shd), out_shardings=shd)
@@ -358,8 +397,17 @@ class NetTrainer:
         return distributed.local_batch_size(self.batch_size)
 
     def _pad_batch(self, batch: DataBatch):
-        """Pad a short batch up to the local batch (static shapes)."""
+        """Pad a short batch up to the local batch (static shapes).
+
+        Sparse CSR batches (data.h:96-181) densify to the net input
+        shape first - the jitted step consumes static dense tensors."""
         b = batch.batch_size
+        if batch.is_sparse():
+            c, y, x = self.net_cfg.input_shape
+            batch = DataBatch(
+                data=batch.to_dense(c * y * x).reshape(b, c, y, x),
+                label=batch.label, inst_index=batch.inst_index,
+                num_batch_padd=batch.num_batch_padd)
         if b == self._local_batch:
             return batch.data, batch.label, batch.valid_mask()
         if b > self._local_batch:
@@ -389,13 +437,11 @@ class NetTrainer:
         glabels = {k: distributed.put_global(v, shd)
                    for k, v in labels.items()}
         gmask = distributed.put_global(mask.astype(np.float32), shd)
-        self.state, loss, outs = self._train_step(
+        # the step is dispatched asynchronously and train metrics
+        # accumulate on device - nothing here blocks on the result, so
+        # host-side input prep for batch k+1 overlaps compute of batch k
+        self.state, loss = self._train_step(
             self.state, gdata, glabels, gmask, rng)
-        if self.eval_train:
-            preds = [distributed.fetch_local(outs[nid])
-                     for _, nid in self.eval_nodes]
-            preds = [p.reshape(p.shape[0], -1) for p in preds]
-            self.train_metric.add_eval(preds, labels, mask=mask > 0)
         # host mirror of the device epoch counter (one update per
         # update_period steps) - avoids forcing a device sync per step
         self.epoch = self._epoch_base + (self._step_counter
@@ -444,9 +490,24 @@ class NetTrainer:
         return self.metric.print(data_name)
 
     def eval_train_metric(self) -> str:
+        from cxxnet_tpu.utils import metric_jit
+        specs = self.train_metric.specs
+        if specs and self.state is not None:
+            vals = distributed.fetch_local(self.state["tmetric"])
+            out = metric_jit.format_metrics("train", specs, vals)
+            self.clear_train_metric()
+            return out
         out = self.train_metric.print("train")
         self.train_metric.clear()
         return out
+
+    def clear_train_metric(self) -> None:
+        """Zero the on-device train-metric accumulator."""
+        self.train_metric.clear()
+        if self.state is not None and "tmetric" in self.state:
+            n = len(self.train_metric)
+            self.state["tmetric"] = distributed.put_global(
+                np.zeros((n, 2), np.float32), self._replicated)
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """Prediction = argmax of the final node (or raw scalar);
@@ -486,8 +547,13 @@ class NetTrainer:
             return
         opt = None
         if self.save_optimizer:
-            opt = jax.tree.map(distributed.fetch_local,
-                               self.state["ustate"])
+            opt = self.state["ustate"]
+            if self.shard_optimizer:
+                # re-replicate ZeRO-sharded state (one all-gather) so the
+                # host readback sees full tensors on every process
+                opt = jax.jit(lambda t: t,
+                              out_shardings=self._replicated)(opt)
+            opt = jax.tree.map(distributed.fetch_local, opt)
         checkpoint.save_model(fo, 0, self.net_cfg.to_dict(), self.epoch,
                               params, opt)
 

@@ -125,12 +125,48 @@ lrn_pallas.defvjp(_vjp_fwd, _vjp_bwd)
 
 
 def use_pallas_lrn(x: jax.Array) -> bool:
-    """Kernel eligibility: TPU backend + channel dim tiles cleanly.
+    """Single-device eligibility: TPU backend + channel dim tiles
+    cleanly. On a multi-device mesh use the shard_map route below -
+    pallas_call alone has no GSPMD partitioning rule."""
+    return (_backend_ok() and jax.device_count() == 1 and _tile_ok(x))
 
-    Restricted to single-device processes: pallas_call has no GSPMD
-    partitioning rule, so inside a sharded jit over a multi-device mesh
-    it cannot be auto-partitioned (the XLA reduce_window path shards
-    fine). Multi-chip use needs a shard_map route - future work.
+
+# test hook: force the kernel on non-TPU backends in interpret mode so
+# the shard_map route is exercised on the virtual CPU mesh
+_FORCE_INTERPRET = False
+
+
+def _backend_ok() -> bool:
+    return jax.default_backend() == "tpu" or _FORCE_INTERPRET
+
+
+def use_pallas_lrn_sharded(x: jax.Array, mesh) -> bool:
+    """shard_map-route eligibility over `mesh`: LRN is per-sample, so
+    sharding the batch over the 'data' axis needs no cross-device
+    communication; each device runs the kernel on its local shard.
+    Requires the per-shard batch to be whole and the channel tiling
+    constraint on the (unchanged) per-shard channel dim."""
+    if not _backend_ok() or mesh is None or "data" not in mesh.axis_names:
+        return False
+    ndata = mesh.shape["data"]
+    return x.shape[0] % ndata == 0 and _tile_ok(x)
+
+
+def lrn_pallas_sharded(x, mesh, local_size, alpha, beta, knorm):
+    """lrn_pallas over a multi-device mesh: batch dim sharded on 'data',
+    channels/spatial replicated within each shard. If the operand arrives
+    channel-sharded (tensor parallelism), GSPMD gathers channels first -
+    the same all-gather the XLA reduce_window path would need for its
+    cross-channel window.
     """
-    return (jax.default_backend() == "tpu" and jax.device_count() == 1
-            and _tile_ok(x))
+    from jax.sharding import PartitionSpec as P
+    spec = P("data", *(None,) * (x.ndim - 1))
+    fn = jax.shard_map(
+        lambda xs: lrn_pallas(xs, local_size, alpha, beta, knorm,
+                              _FORCE_INTERPRET),
+        mesh=mesh, in_specs=spec, out_specs=spec,
+        # pallas_call's out_shape carries no varying-mesh-axes info;
+        # the per-shard computation touches no collectives, so the
+        # vma check has nothing to verify anyway
+        check_vma=False)
+    return fn(x)

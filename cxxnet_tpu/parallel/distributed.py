@@ -113,6 +113,18 @@ def put_global(arr: np.ndarray, sharding) -> jax.Array:
     return jax.make_array_from_process_local_data(sharding, arr)
 
 
+def put_global_full(arr: np.ndarray, sharding) -> jax.Array:
+    """FULL (global-shaped) host value -> global array under any
+    sharding. Unlike put_global, correct for shardings that split over
+    devices owned by several processes (e.g. ZeRO-1 optimizer state):
+    each process materializes only the shards it owns."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
 def fetch_local(arr: jax.Array) -> np.ndarray:
     """Global array -> this process's host view.
 

@@ -21,6 +21,33 @@ import jax
 from jax.sharding import Mesh
 
 
+_ACTIVE_MESH: List[Optional[Mesh]] = [None]
+
+
+class active_mesh:
+    """Context manager binding 'the mesh this forward runs over' so ops
+    deep in the layer stack (e.g. the Pallas LRN shard_map route,
+    ops/pallas_lrn.py) can partition themselves without the mesh being
+    threaded through every Layer.apply signature. The trainer enters it
+    around net.forward inside the traced step, so the binding is active
+    exactly while that trainer's trace runs (re-entrant per trainer)."""
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _ACTIVE_MESH.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _ACTIVE_MESH.pop()
+        return False
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH[-1]
+
+
 @dataclass
 class MeshSpec:
     device_indices: Optional[List[int]] = None  # None = single device

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cxxnet_tpu.nnet.network import Network, param_key
 
 MODEL_AXIS = "model"
+DATA_AXIS = "data"
 
 
 def param_pspecs(net: Network, shapes=None) -> Dict[str, Dict[str, P]]:
@@ -54,6 +55,44 @@ def param_pspecs(net: Network, shapes=None) -> Dict[str, Dict[str, P]]:
                 spec[d] = MODEL_AXIS
                 specs[lk][pn] = P(*spec)
     return specs
+
+
+def zero1_shardings(
+        mesh: Mesh, net: Network,
+        pshard: Dict[str, Dict[str, NamedSharding]]
+) -> Dict[str, Dict[str, NamedSharding]]:
+    """ZeRO-1-style optimizer-state shardings: the update_on_server
+    analog (nnet_ps_server.cpp:20-170 moves the updater to the server so
+    workers don't replicate its state; here the state is sharded over
+    the 'data' axis and GSPMD partitions the update math + all-gathers
+    the fresh weights).
+
+    Starting from each weight's parameter sharding, the first
+    still-unsharded dim divisible by the data-axis size additionally
+    rides 'data'. Weights with no such dim keep the parameter sharding
+    (replication over data is always legal).
+    """
+    dsize = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        DATA_AXIS, 1)
+    shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
+    out: Dict[str, Dict[str, NamedSharding]] = {}
+    for lk, d in pshard.items():
+        out[lk] = {}
+        for pn, ns in d.items():
+            shape = shapes[lk][pn].shape
+            if dsize <= 1:
+                out[lk][pn] = ns
+                continue
+            spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
+            for i, ax in enumerate(spec):
+                if ax is None and shape[i] % dsize == 0:
+                    spec[i] = DATA_AXIS
+                    break
+            else:
+                out[lk][pn] = ns
+                continue
+            out[lk][pn] = NamedSharding(mesh, P(*spec))
+    return out
 
 
 def shardings_for(mesh: Mesh,

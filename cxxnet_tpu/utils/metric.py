@@ -165,6 +165,11 @@ class MetricSet:
     def fields(self) -> List[str]:
         return list(self._fields)
 
+    @property
+    def specs(self) -> List[tuple]:
+        """[(metric_name, label_field)] in declaration order."""
+        return [(m.name, f) for m, f in zip(self._metrics, self._fields)]
+
     def clear(self) -> None:
         for m in self._metrics:
             m.clear()

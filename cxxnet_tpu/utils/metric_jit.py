@@ -1,0 +1,116 @@
+"""Train metrics computed inside the jitted train step.
+
+The reference computes train-metrics from the forward pass it already ran
+(nnet_impl-inl.hpp:174-180) without any extra device sync. The round-1
+trainer instead read the eval nodes back to the host every step
+(fetch_local per batch), serializing the device. These are the same
+metric formulas as utils/metric.py (behavioral parity with
+src/utils/metric.h:20-236) expressed as jnp ops so the accumulation
+lives ON DEVICE: each metric contributes a (sum, count) pair that the
+train step adds into a carried `(n_metrics, 2)` float32 accumulator;
+the host reads it back once per round (or print_step), not per batch.
+
+Masking: padded rows (validity mask == 0) contribute to neither sum nor
+count, matching MetricSet.add_eval(mask=...).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+StepFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array],
+                  Tuple[jax.Array, jax.Array]]
+
+
+def _masked(vals: jax.Array, mask: jax.Array):
+    """(sum over valid rows, number of valid rows)."""
+    m = mask > 0
+    return (jnp.sum(jnp.where(m, vals, 0.0)),
+            jnp.sum(m.astype(jnp.float32)))
+
+
+def _error(pred, label, mask, rng):
+    """argmax != label[:,0]; single column decides by pred>0
+    (metric.h:91-110)."""
+    if pred.shape[1] == 1:
+        maxidx = (pred[:, 0] > 0.0).astype(jnp.int32)
+    else:
+        maxidx = jnp.argmax(pred, axis=1).astype(jnp.int32)
+    wrong = (maxidx != label[:, 0].astype(jnp.int32)).astype(jnp.float32)
+    return _masked(wrong, mask)
+
+
+def _rmse(pred, label, mask, rng):
+    """Per-row SUM of squared differences, no sqrt (the reference quirk,
+    metric.h:72-88)."""
+    if pred.shape != label.shape:
+        raise ValueError(
+            "rmse metric requires pred and label of identical shape")
+    diff = pred - label
+    return _masked(jnp.sum(diff * diff, axis=1), mask)
+
+
+def _logloss(pred, label, mask, rng):
+    # the host path clips p to [eps, 1-eps] in float64; in float32
+    # 1-1e-15 rounds to 1.0, so clip each log argument instead - a
+    # saturated p==1.0 then yields log(clip(1-p)) = log(eps), not -inf
+    eps = 1e-15
+    if pred.shape[1] == 1:
+        p = pred[:, 0]
+        y = label[:, 0]
+        vals = -(y * jnp.log(jnp.clip(p, eps, 1.0))
+                 + (1.0 - y) * jnp.log(jnp.clip(1.0 - p, eps, 1.0)))
+    else:
+        target = label[:, 0].astype(jnp.int32)
+        p = jnp.take_along_axis(pred, target[:, None], axis=1)[:, 0]
+        vals = -jnp.log(jnp.clip(p, eps, 1.0))
+    return _masked(vals, mask)
+
+
+def _make_recall(topn: int) -> StepFn:
+    def rec(pred, label, mask, rng):
+        n, k = pred.shape
+        if k < topn:
+            raise ValueError(
+                f"rec@{topn} meaningless for prediction list of size {k}")
+        # random tie-break like the reference's pre-sort shuffle
+        # (metric.h:149-153); jitter only reorders exact ties
+        jitter = jax.random.uniform(rng, pred.shape)
+        order = jnp.lexsort((jitter, -pred), axis=1)
+        top = order[:, :topn]
+        labels = label.astype(jnp.int32)
+        hits = jnp.any(top[:, :, None] == labels[:, None, :], axis=1)
+        vals = hits.sum(axis=1) / labels.shape[1]
+        return _masked(vals.astype(jnp.float32), mask)
+    return rec
+
+
+def create_step_fn(name: str) -> StepFn:
+    """Factory mirroring utils.metric.create_metric; each returned fn maps
+    (pred2d, label, mask, rng) -> (sum, count) as traced scalars."""
+    if name == "error":
+        return _error
+    if name == "rmse":
+        return _rmse
+    if name == "logloss":
+        return _logloss
+    if name.startswith("rec@"):
+        return _make_recall(int(name[4:]))
+    raise ValueError(f"Metric: unknown metric name: {name}")
+
+
+def format_metrics(evname: str, specs, sums_counts) -> str:
+    """Render accumulated (sum, count) rows in the reference print format
+    `\\t{evname}-{metric}[{field}]:{value}` (metric.h:216-235; field
+    suffix omitted for the default "label" field)."""
+    out = []
+    for (name, field), (s, c) in zip(specs, sums_counts):
+        val = s / c if c else float("nan")
+        tag = f"{evname}-{name}"
+        if field != "label":
+            tag += f"[{field}]"
+        out.append(f"\t{tag}:{val:g}")
+    return "".join(out)

@@ -1,0 +1,39 @@
+"""Real-data acceptance: MNIST_CONV.conf on the sklearn handwritten
+digits corpus reaches >=98% eval accuracy (docs/acceptance/README.md;
+the reference bar is example/MNIST/README.md:104-109,208 on MNIST,
+which has no offline source here).
+
+Slow (~2 min CPU): gated behind CXN_RUN_ACCEPTANCE=1.
+"""
+
+import os
+import re
+import shutil
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("CXN_RUN_ACCEPTANCE") != "1",
+    reason="slow acceptance run; set CXN_RUN_ACCEPTANCE=1")
+
+
+def test_conv_digits_accuracy(tmp_path, capfd):
+    from cxxnet_tpu.main import LearnTask
+    from tools.digits_to_idx import build
+
+    build(str(tmp_path / "data"))
+    conf_src = os.path.join(os.path.dirname(__file__), "..",
+                            "examples", "MNIST", "MNIST_CONV.conf")
+    conf = str(tmp_path / "MNIST_CONV.conf")
+    shutil.copy(conf_src, conf)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        LearnTask().run([conf, "dev=cpu", "silent=1", "num_round=40",
+                         "max_round=40", "save_model=0"])
+    finally:
+        os.chdir(cwd)
+    err = capfd.readouterr().err
+    last = [l for l in err.strip().splitlines() if "test-error" in l][-1]
+    test_err = float(re.search(r"test-error:([0-9.]+)", last).group(1))
+    assert test_err <= 0.02, f"acceptance failed: {last}"

@@ -105,6 +105,16 @@ def test_cli_train_reaches_high_accuracy(dataset, capfd):
     assert full.splitlines()[-1].startswith("[6]")
 
 
+def test_cli_test_on_server_check(dataset, capfd):
+    """test_on_server=1 runs the per-round replicated-weight consistency
+    check (CheckWeight_ analog, async_updater-inl.hpp:144-153)."""
+    tmp_path, conf = dataset
+    LearnTask().run([conf, "test_on_server=1", "num_round=2",
+                     "save_model=0"])
+    err, _ = last_eval_error(capfd)
+    assert np.isfinite(err)  # training completed with the check enabled
+
+
 def test_cli_continue_training(dataset, capfd):
     tmp_path, conf = dataset
     LearnTask().run([conf, "num_round=3"])

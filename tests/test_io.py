@@ -280,6 +280,97 @@ silent = 1
     np.testing.assert_allclose(b.data, b2.data)
 
 
+def test_sparse_csr_batch_view():
+    """Sparse CSR DataBatch (data.h:96-181): row access + densify."""
+    from cxxnet_tpu.io.data import DataBatch
+    row_ptr = np.array([0, 2, 2, 5], np.int64)
+    findex = np.array([1, 3, 0, 2, 3], np.uint32)
+    fvalue = np.array([1.0, 2.0, 3.0, 4.0, 5.0], np.float32)
+    label = np.arange(3, dtype=np.float32).reshape(3, 1)
+    b = DataBatch(label=label, inst_index=np.array([7, 8, 9], np.uint32),
+                  sparse_row_ptr=row_ptr, sparse_findex=findex,
+                  sparse_fvalue=fvalue)
+    assert b.is_sparse() and b.batch_size == 3
+    r0 = b.get_row_sparse(0)
+    assert r0.length == 2 and r0.index == 7
+    np.testing.assert_array_equal(r0.findex, [1, 3])
+    r1 = b.get_row_sparse(1)
+    assert r1.length == 0  # empty row
+    dense = b.to_dense(4)
+    assert dense.shape == (3, 1, 1, 4)
+    np.testing.assert_allclose(dense[0, 0, 0], [0, 1, 0, 2])
+    np.testing.assert_allclose(dense[1, 0, 0], [0, 0, 0, 0])
+    np.testing.assert_allclose(dense[2, 0, 0], [3, 0, 4, 5])
+
+
+def test_sparse_batch_feeds_trainer():
+    """A sparse batch densifies through the trainer input path."""
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    cfg = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 4
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,6
+batch_size = 4
+eta = 0.1
+metric = error
+"""
+    t = NetTrainer()
+    for k, v in parse_config_string(cfg):
+        t.set_param(k, v)
+    t.set_param("silent", "1")
+    t.init_model()
+    row_ptr = np.array([0, 1, 3, 3, 6], np.int64)
+    sp = DataBatch(
+        label=np.zeros((4, 1), np.float32),
+        sparse_row_ptr=row_ptr,
+        sparse_findex=np.array([0, 2, 5, 1, 3, 4], np.uint32),
+        sparse_fvalue=np.ones(6, np.float32))
+    t.update(sp)
+    pred = t.predict(sp)
+    assert pred.shape == (4,)
+
+
+def test_mean_image_reference_binary_layout(tmp_path):
+    """The mean file is the mshadow SaveBinary layout the reference
+    reads/writes (iter_augment_proc-inl.hpp:76-84,193): uint32 shape[3]
+    + float32 data; .npy files from earlier rounds still load."""
+    import struct
+    from cxxnet_tpu.io.augment import load_mean_image, save_mean_image
+
+    # hand-built reference-layout file -> loads
+    ref_path = str(tmp_path / "ref_mean.bin")
+    mean = np.arange(3 * 4 * 5, dtype=np.float32).reshape(3, 4, 5)
+    with open(ref_path, "wb") as fo:
+        fo.write(struct.pack("<3I", 3, 4, 5))
+        fo.write(mean.tobytes())
+    np.testing.assert_array_equal(load_mean_image(ref_path), mean)
+
+    # our writer produces byte-identical layout
+    out_path = str(tmp_path / "out_mean.bin")
+    save_mean_image(out_path, mean)
+    with open(out_path, "rb") as fi, open(ref_path, "rb") as fr:
+        assert fi.read() == fr.read()
+
+    # .npy back-compat sniffing
+    npy_path = str(tmp_path / "legacy.npy")
+    np.save(npy_path, mean)
+    np.testing.assert_array_equal(load_mean_image(npy_path), mean)
+
+    # truncated file errors out instead of yielding garbage
+    with open(ref_path, "rb") as fi:
+        blob = fi.read()
+    bad = str(tmp_path / "trunc.bin")
+    with open(bad, "wb") as fo:
+        fo.write(blob[:-8])
+    with pytest.raises(ValueError):
+        load_mean_image(bad)
+
+
 def test_affine_augmentation_runs(tmp_path):
     lst, root, _ = write_images(tmp_path, n=4, size=16)
     it = make_iter(f"""

@@ -37,6 +37,55 @@ def test_grad_matches_xla(shape, n):
     np.testing.assert_allclose(gr, gp, rtol=1e-4, atol=1e-5)
 
 
+def test_sharded_matches_xla_multi_device(monkeypatch):
+    """shard_map route on the 8-device virtual mesh (interpret mode) ==
+    XLA path, forward and grad - the multi-chip flagship scenario the
+    kernel used to be hard-disabled in."""
+    from cxxnet_tpu.ops import pallas_lrn
+    from cxxnet_tpu.parallel.mesh import MeshSpec, build_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert len(jax.devices()) == 8
+    monkeypatch.setattr(pallas_lrn, "_FORCE_INTERPRET", True)
+    mesh = build_mesh(MeshSpec(device_indices=list(range(8))), 16)
+    rng = np.random.RandomState(2)
+    x = jax.device_put(rng.randn(16, 16, 5, 7).astype(np.float32),
+                       NamedSharding(mesh, P("data")))
+    n, alpha, beta, knorm = 5, 0.001, 0.75, 1.0
+    assert pallas_lrn.use_pallas_lrn_sharded(x, mesh)
+
+    ref = lrn(x, n, alpha, beta, knorm)  # XLA (CPU backend -> not pallas)
+    got = jax.jit(lambda x: pallas_lrn.lrn_pallas_sharded(
+        x, mesh, n, alpha, beta, knorm))(x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-6)
+
+    g = rng.randn(*x.shape).astype(np.float32)
+    gr = jax.grad(lambda x: jnp.sum(lrn(x, n, alpha, beta, knorm) * g))(x)
+    gp = jax.jit(jax.grad(lambda x: jnp.sum(
+        pallas_lrn.lrn_pallas_sharded(x, mesh, n, alpha, beta, knorm)
+        * g)))(x)
+    np.testing.assert_allclose(np.asarray(gr), np.asarray(gp),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_eligibility():
+    from cxxnet_tpu.ops import pallas_lrn
+    from cxxnet_tpu.parallel.mesh import MeshSpec, build_mesh
+    mesh = build_mesh(MeshSpec(device_indices=list(range(8))), 16)
+    x = jnp.zeros((16, 16, 5, 7), jnp.float32)
+    # CPU backend without the interpret override -> ineligible
+    assert not pallas_lrn.use_pallas_lrn_sharded(x, mesh)
+    # batch not divisible by the data axis -> ineligible even forced
+    try:
+        pallas_lrn._FORCE_INTERPRET = True
+        bad = jnp.zeros((12, 16, 5, 7), jnp.float32)
+        assert not pallas_lrn.use_pallas_lrn_sharded(bad, mesh)
+        assert pallas_lrn.use_pallas_lrn_sharded(x, mesh)
+    finally:
+        pallas_lrn._FORCE_INTERPRET = False
+
+
 def test_eligibility_gate():
     # CPU backend in tests -> never eligible; odd channel counts never
     x32 = jnp.zeros((1, 96, 4, 4), jnp.float32)

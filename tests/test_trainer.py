@@ -79,7 +79,7 @@ def test_training_converges():
         t.start_round(r)
         for b in batches:
             t.update(b)
-        t.train_metric.clear()
+        t.clear_train_metric()
     # eval error on held-out batches from the same distribution
     out = t.evaluate(ListIter(synth_batches(5, seed=0)), "test")
     err = float(out.split(":")[-1])
@@ -229,10 +229,76 @@ def test_data_parallel_multi_device_matches_single():
         np.asarray(t8.state["params"]["fc1"]["wmat"]), rtol=2e-4, atol=1e-5)
 
 
+def test_shard_optimizer_zero1_matches_replicated():
+    """ZeRO-1 optimizer-state sharding (update_on_server analog,
+    nnet_ps_server.cpp:20-170): same math, state sharded over 'data'."""
+    t_rep = make_trainer(extra="dev = tpu:0-7\n")
+    t_z1 = make_trainer(extra="dev = tpu:0-7\nshard_optimizer = 1\n")
+    st = t_z1.state["ustate"]["fc1"]["wmat"]["m"]
+    assert not st.sharding.is_fully_replicated, st.sharding
+    assert "data" in t_z1._ustate_shard["fc1"]["wmat"].spec
+    for b in synth_batches(5):
+        t_rep.update(b)
+        t_z1.update(b)
+    np.testing.assert_allclose(
+        np.asarray(t_rep.state["params"]["fc1"]["wmat"]),
+        np.asarray(t_z1.state["params"]["fc1"]["wmat"]),
+        rtol=2e-4, atol=1e-5)
+    # momentum state agrees too (after gathering the shards)
+    np.testing.assert_allclose(
+        np.asarray(t_rep.state["ustate"]["fc1"]["wmat"]["m"]),
+        np.asarray(t_z1.state["ustate"]["fc1"]["wmat"]["m"]),
+        rtol=2e-4, atol=1e-5)
+
+
+def test_shard_optimizer_checkpoint_roundtrip():
+    t = make_trainer(
+        extra="dev = tpu:0-7\nshard_optimizer = 1\nsave_optimizer = 1\n")
+    for b in synth_batches(3):
+        t.update(b)
+    buf = io.BytesIO()
+    t.save_model(buf)
+    buf.seek(0)
+    t2 = make_trainer(extra="save_optimizer = 1\n")
+    t2.load_model(buf)
+    np.testing.assert_allclose(
+        np.asarray(t2.state["ustate"]["fc1"]["wmat"]["m"]),
+        np.asarray(t.state["ustate"]["fc1"]["wmat"]["m"]), rtol=1e-6)
+
+
 def test_device_pruning_for_odd_batch():
     # batch 16 with 5 devices requested -> pruned to 4
     t = make_trainer(extra="dev = tpu:0-4\n")
     assert t.mesh.devices.size == 4
+
+
+def test_on_device_train_metric_matches_host():
+    """The jitted (sum,count) accumulation == the host MetricSet on the
+    same forward outputs (update_period=2 so the first update leaves the
+    params untouched and predict_dist reproduces the training forward)."""
+    from cxxnet_tpu.utils.metric import MetricSet
+    t = make_trainer(extra="update_period = 2\n")
+    b = synth_batches(1)[0]
+    t.update(b)
+    out = t.eval_train_metric()
+    dev_err = float(out.split(":")[-1])
+    host = MetricSet()
+    host.add_metric("error", "label")
+    host.add_eval([t.predict_dist(b)], {"label": b.label})
+    assert abs(dev_err - host._metrics[0].get()) < 1e-6
+    assert out.startswith("\ttrain-error:")
+    # accumulator was reset by the readback
+    assert float(np.asarray(t.state["tmetric"]).sum()) == 0.0
+
+
+def test_train_metric_ignores_padded_rows():
+    t = make_trainer(extra="update_period = 4\n")
+    x = np.random.RandomState(3).randn(10, 1, 1, 8).astype(np.float32)
+    y = np.ones((10, 1), np.float32)
+    t.update(DataBatch(data=x, label=y))  # padded 10 -> 16
+    vals = np.asarray(t.state["tmetric"])
+    assert vals.shape == (1, 2)
+    assert vals[0, 1] == 10.0  # count == valid rows only
 
 
 def test_multi_target_metrics():

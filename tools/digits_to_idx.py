@@ -1,0 +1,73 @@
+"""Write the sklearn handwritten-digits dataset as MNIST idx files.
+
+The reference's acceptance bar is "run example/MNIST/MNIST.conf
+unmodified -> ~98% accuracy" (example/MNIST/README.md:104-109). This
+sandbox has no network egress, so the real MNIST files cannot be
+fetched; the nearest REAL handwriting data available offline is
+sklearn.datasets.load_digits (1797 scanned 8x8 digits from the UCI
+optical-recognition corpus). This tool upsamples them to 28x28 and
+writes gzip idx files with the exact MNIST magic/layout, so MNIST.conf
+runs byte-for-byte unmodified against real handwritten data.
+
+Usage: python tools/digits_to_idx.py <outdir> [test_fraction]
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import sys
+
+import numpy as np
+
+
+def write_idx(out_dir: str, prefix: str, images: np.ndarray,
+              labels: np.ndarray) -> None:
+    """gzip idx files: magic 2051 (images) / 2049 (labels), big-endian
+    dims, uint8 payload - the layout iter_mnist expects."""
+    n, rows, cols = images.shape
+    with gzip.open(os.path.join(
+            out_dir, f"{prefix}-images-idx3-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">iiii", 2051, n, rows, cols))
+        f.write(np.ascontiguousarray(images, np.uint8).tobytes())
+    with gzip.open(os.path.join(
+            out_dir, f"{prefix}-labels-idx1-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">ii", 2049, n))
+        f.write(np.ascontiguousarray(labels, np.uint8).tobytes())
+
+
+def build(out_dir: str, test_fraction: float = 0.2,
+          seed: int = 0) -> tuple:
+    from scipy import ndimage
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    imgs = d.images  # (1797, 8, 8) float in [0, 16]
+    up = np.stack([
+        ndimage.zoom(im, 28.0 / 8.0, order=1) for im in imgs])
+    up = np.clip(up * (255.0 / 16.0), 0, 255).astype(np.uint8)
+    labels = d.target.astype(np.uint8)
+
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(up))
+    n_test = int(len(up) * test_fraction)
+    test_idx, train_idx = order[:n_test], order[n_test:]
+
+    os.makedirs(out_dir, exist_ok=True)
+    write_idx(out_dir, "train", up[train_idx], labels[train_idx])
+    write_idx(out_dir, "t10k", up[test_idx], labels[test_idx])
+    return len(train_idx), n_test
+
+
+def main(argv) -> int:
+    out_dir = argv[0] if argv else "./data"
+    frac = float(argv[1]) if len(argv) > 1 else 0.2
+    ntr, nte = build(out_dir, frac)
+    print(f"wrote {ntr} train / {nte} test real handwritten digits "
+          f"to {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
