@@ -121,16 +121,11 @@ def run(profile_dir="", steps_override=0) -> dict:
     from __graft_entry__ import _ALEXNET_CONF, _make_trainer
     from cxxnet_tpu.utils.config import parse_config_file
 
-    # an explicit JAX_PLATFORMS env must actually win: the tunnel's
-    # sitecustomize registers its plugin into every process, and plain
-    # jax.devices() would initialize it (and hang on a dead tunnel)
-    # even when the env asks for cpu
-    want = os.environ.get("JAX_PLATFORMS", "")
-    if want:
-        try:
-            jax.config.update("jax_platforms", want)
-        except RuntimeError:
-            pass  # backend already initialized
+    # an explicit JAX_PLATFORMS env must actually win: a bare
+    # jax.devices() initializes every registered plugin, including a
+    # possibly-dead tunnel (utils/platform.py)
+    from cxxnet_tpu.utils.platform import ensure_env_platform
+    ensure_env_platform()
     # backend init is the one step that touches the (possibly tunneled)
     # platform - retry transient failures instead of dying rc=1
     last = None

@@ -166,6 +166,7 @@ class ImageBinIterator(DataIter):
         self.shuffle_buffer = 1024
         self._native = None
         self._native_mode = False
+        self._pool = None  # Python-path decode ThreadPoolExecutor
 
     def set_param(self, name: str, val: str) -> None:
         if name == "image_list":
@@ -243,10 +244,17 @@ class ImageBinIterator(DataIter):
         self._q: "queue.Queue" = queue.Queue(maxsize=4)
         self._reader = _PageReader(self.bins, self._q, self._stop)
         self._reader.start()
+        if self._pool is None and self.decode_threads > 0:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.decode_threads,
+                thread_name_prefix="cxn-decode")
         self._page_objs: List[bytes] = []
         self._page_order: List[int] = []
         self._page_pos = 0
         self._entry_pos = 0
+        self._futures = {}
+        self._submit_pos = 0
 
     def _shutdown_reader(self) -> None:
         reader = getattr(self, "_reader", None)
@@ -264,7 +272,26 @@ class ImageBinIterator(DataIter):
         if self.shuffle:
             self.rng.shuffle(self._page_order)
         self._page_pos = 0
+        self._submit_pos = 0
+        self._futures = {}
+        self._fill_decode_window()
         return True
+
+    def _fill_decode_window(self) -> None:
+        """Second pipeline stage of the Python path: keep a bounded
+        window of blobs decoding on the pool (PIL releases the GIL
+        during decompression) while the consumer drains earlier ones -
+        the decode-pool role iter_thread_imbin's pipeline plays, without
+        densifying a whole 64MiB page at once."""
+        if self._pool is None:
+            return
+        ahead = max(8, 2 * self.decode_threads)
+        while (self._submit_pos < len(self._page_order)
+               and self._submit_pos - self._page_pos < ahead):
+            j = self._page_order[self._submit_pos]
+            self._futures[self._submit_pos] = self._pool.submit(
+                decode_image, self._page_objs[j])
+            self._submit_pos += 1
 
     def _pull_native(self) -> Optional[DataInst]:
         data = self._native.next()
@@ -312,13 +339,17 @@ class ImageBinIterator(DataIter):
         while self._page_pos >= len(self._page_objs):
             if not self._next_page():
                 return False
-        blob = self._page_objs[self._page_order[self._page_pos]]
-        ent_idx = self._entry_pos + self._page_order[self._page_pos]
+        k = self._page_pos
+        ent_idx = self._entry_pos + self._page_order[k]
         self._page_pos += 1
+        if k in self._futures:
+            data = self._futures.pop(k).result()
+        else:
+            data = decode_image(self._page_objs[self._page_order[k]])
+        self._fill_decode_window()
         if self._page_pos >= len(self._page_objs):
             self._entry_pos += len(self._page_objs)
         idx, labels, _ = self.entries[ent_idx]
-        data = decode_image(blob)
         label = np.asarray(labels[:self.label_width], dtype=np.float32)
         self._out = DataInst(index=idx, data=data, label=label)
         return True

@@ -51,16 +51,16 @@ def lrn(x, local_size: int, alpha: float, beta: float, knorm: float):
     (lrn_layer-inl.hpp:36-56: tmp_norm = chpool<sum>(x^2) * (alpha/n) + knorm,
     out = x * tmp_norm^(-beta)).
     """
-    from cxxnet_tpu.ops.pallas_lrn import (
-        lrn_pallas, lrn_pallas_sharded, use_pallas_lrn,
-        use_pallas_lrn_sharded)
-    if use_pallas_lrn(x):
-        return lrn_pallas(x, local_size, alpha, beta, knorm)
+    from cxxnet_tpu.ops import pallas_lrn as pk
+    if pk.use_pallas_lrn(x):
+        return pk.lrn_pallas(x, local_size, alpha, beta, knorm,
+                             pk._FORCE_INTERPRET)
     from cxxnet_tpu.parallel.mesh import get_active_mesh
     mesh = get_active_mesh()
     if mesh is not None and mesh.devices.size > 1 \
-            and use_pallas_lrn_sharded(x, mesh):
-        return lrn_pallas_sharded(x, mesh, local_size, alpha, beta, knorm)
+            and pk.use_pallas_lrn_sharded(x, mesh):
+        return pk.lrn_pallas_sharded(x, mesh, local_size, alpha, beta,
+                                     knorm)
     sq = x * x
     pad_lo = local_size // 2
     pad_hi = local_size - pad_lo - 1

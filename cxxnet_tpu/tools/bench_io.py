@@ -1,0 +1,97 @@
+"""Decode-pipeline benchmark: native C++ two-stage reader vs Python
+fallback (docs/io.md).
+
+The reference overlaps page IO with a JPEG decode pool
+(iter_thread_imbin-inl.hpp); `native/cxxnet_io.cc` plays that role here
+and this tool measures what the margin actually is, so the io budget
+for pod-scale feeding is a number, not an assumption (SURVEY.md §7
+hard-part #4).
+
+Generates a synthetic imgbin (JPEG blobs of a given size), then streams
+it through `ImageBinIterator` with `use_native=1` (C++ page reader +
+libjpeg decode pool + reorder buffer) and `use_native=0` (Python page
+prefetch thread + PIL decode on the caller), reporting decoded
+images/sec for each.
+
+Usage: python -m cxxnet_tpu.tools.bench_io [n_images] [size] [threads]
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def make_dataset(tmp: str, n: int, size: int) -> tuple:
+    """Write n JPEGs of (size x size) into an imgbin + list file."""
+    from PIL import Image
+    from cxxnet_tpu.utils.binary_page import BinaryPageWriter
+
+    rng = np.random.RandomState(0)
+    # a handful of distinct images cycled, so dataset build stays fast
+    # but blobs are real JPEG work to decode
+    blobs = []
+    for _ in range(min(n, 16)):
+        arr = rng.randint(0, 255, (size, size, 3), np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+        blobs.append(buf.getvalue())
+    bin_path = os.path.join(tmp, "bench.bin")
+    lst_path = os.path.join(tmp, "bench.lst")
+    with open(bin_path, "wb") as fo:
+        w = BinaryPageWriter(fo)
+        for i in range(n):
+            w.push(blobs[i % len(blobs)])
+        w.close()
+    with open(lst_path, "w") as fo:
+        for i in range(n):
+            fo.write(f"{i}\t0\timg{i}.jpg\n")
+    return lst_path, bin_path
+
+
+def run_mode(lst: str, bin_path: str, use_native: int,
+             threads: int) -> float:
+    from cxxnet_tpu.io.iter_img import ImageBinIterator
+    it = ImageBinIterator()
+    it.set_param("image_list", lst)
+    it.set_param("image_bin", bin_path)
+    it.set_param("use_native", str(use_native))
+    it.set_param("decode_threads", str(threads))
+    it.set_param("silent", "1")
+    it.init()
+    n = 0
+    t0 = time.perf_counter()
+    it.before_first()
+    while it.next():
+        n += 1
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main(argv) -> int:
+    n = int(argv[0]) if len(argv) > 0 else 2000
+    size = int(argv[1]) if len(argv) > 1 else 256
+    threads = int(argv[2]) if len(argv) > 2 else 4
+    from cxxnet_tpu.io.native import native_available
+    with tempfile.TemporaryDirectory() as tmp:
+        lst, bin_path = make_dataset(tmp, n, size)
+        py_ips = run_mode(lst, bin_path, 0, threads)
+        print(f"python decode: {py_ips:.1f} images/sec "
+              f"({n} x {size}x{size} JPEG)")
+        if native_available():
+            nat_ips = run_mode(lst, bin_path, 1, threads)
+            print(f"native decode ({threads} threads): {nat_ips:.1f} "
+                  f"images/sec ({nat_ips / py_ips:.2f}x python)")
+        else:
+            print("native decode: libcxxnet_io.so not built "
+                  "(make -C native)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -9,7 +9,7 @@ optical-recognition corpus). This tool upsamples them to 28x28 and
 writes gzip idx files with the exact MNIST magic/layout, so MNIST.conf
 runs byte-for-byte unmodified against real handwritten data.
 
-Usage: python tools/digits_to_idx.py <outdir> [test_fraction]
+Usage: python -m cxxnet_tpu.tools.digits_to_idx <outdir> [test_fraction]
 """
 
 from __future__ import annotations

@@ -1,0 +1,23 @@
+"""Backend-platform selection guard.
+
+The TPU tunnel's sitecustomize registers its PJRT plugin into every
+python process; a bare `jax.devices()` initializes ALL registered
+platforms, so it can touch (and hang on) the tunnel even when the
+caller exported JAX_PLATFORMS=cpu. Calling this before the first
+device access makes an explicit env choice actually bind.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_env_platform() -> None:
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", want)
+    except RuntimeError:
+        pass  # backend already initialized

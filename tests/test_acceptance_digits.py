@@ -19,7 +19,7 @@ pytestmark = pytest.mark.skipif(
 
 def test_conv_digits_accuracy(tmp_path, capfd):
     from cxxnet_tpu.main import LearnTask
-    from tools.digits_to_idx import build
+    from cxxnet_tpu.tools.digits_to_idx import build
 
     build(str(tmp_path / "data"))
     conf_src = os.path.join(os.path.dirname(__file__), "..",
