@@ -30,8 +30,14 @@ def capture(trace_dir: str, steps: int = 20) -> str:
     path. Reuses the exact harness the headline number comes from so the
     trace explains the benchmark, not a lookalike loop."""
     import jax
-    import bench
-    from __graft_entry__ import _ALEXNET_CONF, _make_trainer
+    try:
+        import bench
+        from __graft_entry__ import _ALEXNET_CONF, _make_trainer
+    except ImportError as e:
+        raise RuntimeError(
+            "profile_step reuses the repo-root bench.py harness; run it "
+            "from a source checkout root (bench/__graft_entry__ are not "
+            "packaged)") from e
     from cxxnet_tpu.utils.config import parse_config_file
     from cxxnet_tpu.utils.platform import ensure_env_platform
 
@@ -62,7 +68,14 @@ def summarize(xplane_path: str, top: int = 25) -> str:
     op_time = defaultdict(float)
     total = 0.0
     for plane in dev_planes:
-        for line in plane.lines:
+        # a device plane carries parallel lines (Steps / XLA Modules /
+        # XLA Ops) covering the same wall time - summing all of them
+        # would triple-count; the "XLA Ops" line holds the leaf op
+        # self-times. Host planes (CPU smoke runs) have thread lines
+        # only, which don't nest the same way.
+        lines = [l for l in plane.lines if l.name == "XLA Ops"] \
+            or list(plane.lines)
+        for line in lines:
             for ev in line.events:
                 dur = ev.duration_ns
                 name = ev.name
