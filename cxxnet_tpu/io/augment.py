@@ -173,6 +173,9 @@ def save_mean_image(path: str, mean: np.ndarray) -> None:
     the file."""
     if mean.ndim != 3:
         raise ValueError("mean image must be (c, y, x)")
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     with open(path, "wb") as fo:
         fo.write(np.asarray(mean.shape, "<u4").tobytes())
         fo.write(np.ascontiguousarray(mean, "<f4").tobytes())
