@@ -72,6 +72,7 @@ class NetTrainer:
         self._loaded_opt = None
         self.save_optimizer = 0
         self.shard_optimizer = 0
+        self.remat = 0
         self.model_format = "native"
         self.profile = 0
         self.profile_dir = ""
@@ -106,6 +107,8 @@ class NetTrainer:
             self.save_optimizer = int(val)
         if name == "shard_optimizer":
             self.shard_optimizer = int(val)
+        if name == "remat":
+            self.remat = int(val)
         if name == "model_format":
             if val not in ("native", "cxxnet"):
                 raise ValueError("model_format must be native or cxxnet")
@@ -294,6 +297,15 @@ class NetTrainer:
             outs = {nid: values[nid].astype(jnp.float32)
                     for nid in eval_node_ids}
             return loss.astype(jnp.float32) * scale, outs
+
+        if self.remat:
+            # remat=1: recompute forward activations in the backward
+            # pass instead of keeping them in HBM - trades FLOPs for
+            # memory, the standard lever for big batches / deep nets on
+            # TPU (the reference's analog is temp_col_max chunking,
+            # convolution_layer-inl.hpp:189-204, which bounds im2col
+            # scratch the same way)
+            loss_fn = jax.checkpoint(loss_fn)
 
         def train_step(state, data, labels, mask, rng):
             (loss, outs), grads = jax.value_and_grad(

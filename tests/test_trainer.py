@@ -229,6 +229,20 @@ def test_data_parallel_multi_device_matches_single():
         np.asarray(t8.state["params"]["fc1"]["wmat"]), rtol=2e-4, atol=1e-5)
 
 
+def test_remat_matches_plain():
+    """remat=1 (jax.checkpoint over the forward) changes memory, not
+    math: training trajectories are identical."""
+    t1 = make_trainer()
+    t2 = make_trainer(extra="remat = 1\n")
+    for b in synth_batches(4):
+        t1.update(b)
+        t2.update(b)
+    np.testing.assert_allclose(
+        np.asarray(t1.state["params"]["fc1"]["wmat"]),
+        np.asarray(t2.state["params"]["fc1"]["wmat"]),
+        rtol=1e-5, atol=1e-6)
+
+
 def test_shard_optimizer_zero1_matches_replicated():
     """ZeRO-1 optimizer-state sharding (update_on_server analog,
     nnet_ps_server.cpp:20-170): same math, state sharded over 'data'."""
