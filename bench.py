@@ -25,10 +25,13 @@ Usage: python bench.py [--profile DIR] [--steps N]
     --profile DIR  additionally capture a jax.profiler trace of the
                    steady-state e2e loop into DIR.
 
-A watchdog thread (CXN_BENCH_TIMEOUT, default 480 s) converts a hung
+A watchdog thread (CXN_BENCH_TIMEOUT, default 480 s) handles a hung
 backend (e.g. a stuck tunnel lease blocking inside PJRT client
-creation, where no Python signal can ever be delivered) into the error
-JSON line + clean exit instead of an rc-143 kill with no artifact.
+creation, where no Python signal can ever be delivered): the first
+occurrence re-execs the process onto the CPU backend so a real,
+clearly-labeled number (JSON field "fallback") is still produced; if
+already on CPU (or the re-exec fails) it prints the error JSON line
+and exits cleanly instead of dying rc-143 with no artifact.
 """
 
 from __future__ import annotations

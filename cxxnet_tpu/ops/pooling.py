@@ -9,9 +9,21 @@ i.e. the last window may be truncated at the boundary. We reproduce this
 with explicit high padding and neutral init values (-inf for max, 0 for
 sum/avg); avg pooling divides by the FULL window size k*k even for
 truncated windows, matching mshadow pool<sum> scaled by 1/(ky*kx).
+
+Max-pool backward parity: the reference's unpool (pooling layer
+backprop via mshadow unpool<maximum>) assigns the window's gradient to
+EVERY source position equal to the window max - on ties (ubiquitous
+after relu, where windows are full of equal zeros) ALL tied positions
+receive the full gradient. XLA's native reduce_window-max gradient
+(select_and_scatter) picks a single winner instead, so max_pool2d
+carries a custom_vjp implementing the reference rule exactly, built
+from ky*kx shifted comparisons (fuses to elementwise work; also avoids
+select_and_scatter, a historically slow lowering on TPU).
 """
 
 from __future__ import annotations
+
+import functools
 
 import numpy as np
 
@@ -43,19 +55,68 @@ def pool2d(x: jax.Array, mode: str, ksize_y: int, ksize_x: int,
     """
     hi_y = _pool_padding(x.shape[2], ksize_y, stride, pad_y)
     hi_x = _pool_padding(x.shape[3], ksize_x, stride, pad_x)
-    padding = ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x))
-    window = (1, 1, ksize_y, ksize_x)
-    strides = (1, 1, stride, stride)
     if mode == "max":
-        init = -jnp.inf
-        out = lax.reduce_window(x, init, lax.max, window, strides, padding)
+        out = max_pool2d(x, ksize_y, ksize_x, stride, pad_y, pad_x,
+                         hi_y, hi_x)
     elif mode in ("sum", "avg"):
-        out = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        out = lax.reduce_window(
+            x, 0.0, lax.add, (1, 1, ksize_y, ksize_x),
+            (1, 1, stride, stride),
+            ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x)))
         if mode == "avg":
             out = out * (1.0 / (ksize_y * ksize_x))
     else:
         raise ValueError(f"unknown pooling mode {mode!r}")
     return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
+def max_pool2d(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x):
+    """Max pooling with the reference's unpool backward (see module
+    docstring). Padding args are precomputed by pool2d."""
+    window = (1, 1, ky, kx)
+    strides = (1, 1, stride, stride)
+    padding = ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x))
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                             padding)
+
+
+def _max_pool_fwd(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x):
+    out = max_pool2d(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x)
+    return out, (x, out)
+
+
+def _upsample_shift(a, stride, dy, dx, hp, wp, fill):
+    """Place a[oy, ox] at padded-input position (oy*stride + dy,
+    ox*stride + dx); everything else = fill. Interior padding by
+    (stride-1) does the strided upsample, edge padding the shift."""
+    cfg = [(0, 0, 0), (0, 0, 0),
+           (dy, hp - dy - (a.shape[2] - 1) * stride - 1, stride - 1),
+           (dx, wp - dx - (a.shape[3] - 1) * stride - 1, stride - 1)]
+    return lax.pad(a, jnp.asarray(fill, a.dtype), cfg)
+
+
+def _max_pool_bwd(ky, kx, stride, pad_y, pad_x, hi_y, hi_x, res, g):
+    x, out = res
+    hp = x.shape[2] + pad_y + hi_y
+    wp = x.shape[3] + pad_x + hi_x
+    xpad = jnp.pad(x, ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x)),
+                   constant_values=-jnp.inf)
+    gin = jnp.zeros_like(xpad)
+    for dy in range(ky):
+        for dx in range(kx):
+            # window oy covers padded position i at offset dy iff
+            # i == oy*stride + dy; compare xpad against that window's
+            # max and claim its gradient on equality (ties included)
+            up_out = _upsample_shift(out, stride, dy, dx, hp, wp,
+                                     -jnp.inf)
+            up_g = _upsample_shift(g, stride, dy, dx, hp, wp, 0.0)
+            gin = gin + jnp.where(xpad == up_out, up_g, 0.0)
+    gin = gin[:, :, pad_y:pad_y + x.shape[2], pad_x:pad_x + x.shape[3]]
+    return (gin.astype(x.dtype),)
+
+
+max_pool2d.defvjp(_max_pool_fwd, _max_pool_bwd)
 
 
 def insanity_pool2d(x: jax.Array, rng: jax.Array, ksize_y: int, ksize_x: int,

@@ -1,0 +1,85 @@
+"""Max-pool backward parity with the reference's unpool rule: every
+source position equal to the window max receives the FULL window
+gradient (ties duplicated), unlike XLA's single-winner
+select_and_scatter. Differential-tested against a direct numpy
+transcription of the rule and, on tie-free inputs, against XLA's own
+reduce_window gradient (ops/pooling.py module docstring)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cxxnet_tpu.ops.pooling import pool2d, pool_out_dim
+
+
+def numpy_unpool_grad(x, g, k, s):
+    """gin[i] = sum over windows w covering i of g[w] * (x[i]==max_w)."""
+    b, c, h, w = x.shape
+    oh, ow = pool_out_dim(h, k, s), pool_out_dim(w, k, s)
+    gin = np.zeros_like(x)
+    for oy in range(oh):
+        for ox in range(ow):
+            ys, xs = oy * s, ox * s
+            win = x[:, :, ys:ys + k, xs:xs + k]
+            m = win.max(axis=(2, 3), keepdims=True)
+            gin[:, :, ys:ys + k, xs:xs + k] += np.where(
+                win == m, g[:, :, oy:oy + 1, ox:ox + 1], 0.0)
+    return gin
+
+
+def _grad(x, k, s):
+    rng = np.random.RandomState(1)
+    oh, ow = pool_out_dim(x.shape[2], k, s), pool_out_dim(x.shape[3], k, s)
+    g = rng.randn(x.shape[0], x.shape[1], oh, ow).astype(np.float32)
+    gr = jax.grad(lambda x: jnp.sum(pool2d(x, "max", k, k, s) * g))(
+        jnp.asarray(x))
+    return np.asarray(gr), g
+
+
+def test_ties_get_duplicated_gradient():
+    # a window of identical values (the post-relu all-zeros case):
+    # every position must receive the full window gradient
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    gr, g = _grad(x, 2, 2)
+    expect = numpy_unpool_grad(x, g, 2, 2)
+    np.testing.assert_allclose(gr, expect, rtol=1e-6)
+    assert np.count_nonzero(gr) == 16  # all tied positions claimed
+
+
+def test_overlapping_windows_match_numpy_rule():
+    rng = np.random.RandomState(0)
+    # quantized values -> frequent cross-window ties, overlapping 3x3 s2
+    x = rng.randint(0, 4, (2, 3, 9, 9)).astype(np.float32)
+    gr, g = _grad(x, 3, 2)
+    expect = numpy_unpool_grad(x, g, 3, 2)
+    np.testing.assert_allclose(gr, expect, rtol=1e-6)
+
+
+def test_distinct_values_match_xla_native_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 11, 7).astype(np.float32)  # ties ~impossible
+    for k, s in ((2, 2), (3, 2), (3, 3)):
+        gr, g = _grad(x, k, s)
+
+        def native(x):
+            hp = (pool_out_dim(x.shape[2], k, s) - 1) * s + k - x.shape[2]
+            wp = (pool_out_dim(x.shape[3], k, s) - 1) * s + k - x.shape[3]
+            out = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 1, k, k), (1, 1, s, s),
+                ((0, 0), (0, 0), (0, max(0, hp)), (0, max(0, wp))))
+            return jnp.sum(out * g)
+
+        nat = np.asarray(jax.grad(native)(jnp.asarray(x)))
+        np.testing.assert_allclose(gr, nat, rtol=1e-6, atol=1e-7)
+
+
+def test_truncated_boundary_window():
+    # reference ceil formula: in=5, k=2, s=2 -> out=3, last window
+    # truncated to a single column/row
+    rng = np.random.RandomState(3)
+    x = rng.randint(0, 3, (1, 2, 5, 5)).astype(np.float32)
+    gr, g = _grad(x, 2, 2)
+    expect = numpy_unpool_grad(x, g, 2, 2)
+    np.testing.assert_allclose(gr, expect, rtol=1e-6)
