@@ -55,6 +55,8 @@ param_server = dist
 t = NetTrainer()
 for k, v in parse_config_string(NET):
     t.set_param(k, v)
+for k, v in parse_config_string(os.environ.get("CXN_TEST_EXTRA", "")):
+    t.set_param(k, v)
 t.init_model()
 
 nproc = jax.process_count()
@@ -107,7 +109,7 @@ def _single_process_reference(tmp_path):
     return w
 
 
-def test_two_process_training_matches_single(tmp_path):
+def _run_two_process(tmp_path, extra_cfg=""):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     out_prefix = str(tmp_path / "w")
@@ -125,6 +127,7 @@ def test_two_process_training_matches_single(tmp_path):
         env["CXN_WORKER_RANK"] = str(rank)
         env["CXN_TEST_REPO"] = REPO
         env["CXN_TEST_OUT"] = out_prefix
+        env["CXN_TEST_EXTRA"] = extra_cfg
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -136,7 +139,23 @@ def test_two_process_training_matches_single(tmp_path):
         assert p.returncode == 0, out
     w0 = np.load(f"{out_prefix}.0.npy")
     w1 = np.load(f"{out_prefix}.1.npy")
+    return w0, w1
+
+
+def test_two_process_training_matches_single(tmp_path):
+    w0, w1 = _run_two_process(tmp_path)
     np.testing.assert_array_equal(w0, w1)  # cross-process identical
+    ref = _single_process_reference(tmp_path)
+    np.testing.assert_allclose(w0, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_two_process_zero1_matches_single(tmp_path):
+    """shard_optimizer=1 across 2 real processes: updater state shards
+    over devices owned by DIFFERENT processes (put_global_full path +
+    GSPMD-partitioned update); training math is unchanged."""
+    w0, w1 = _run_two_process(tmp_path,
+                              extra_cfg="shard_optimizer = 1\n")
+    np.testing.assert_array_equal(w0, w1)
     ref = _single_process_reference(tmp_path)
     np.testing.assert_allclose(w0, ref, rtol=1e-5, atol=1e-6)
 

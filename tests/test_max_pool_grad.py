@@ -14,26 +14,31 @@ from jax import lax
 from cxxnet_tpu.ops.pooling import pool2d, pool_out_dim
 
 
-def numpy_unpool_grad(x, g, k, s):
+def numpy_unpool_grad(x, g, k, s, pad=0):
     """gin[i] = sum over windows w covering i of g[w] * (x[i]==max_w)."""
     b, c, h, w = x.shape
-    oh, ow = pool_out_dim(h, k, s), pool_out_dim(w, k, s)
-    gin = np.zeros_like(x)
+    oh = pool_out_dim(h, k, s, pad)
+    ow = pool_out_dim(w, k, s, pad)
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)),
+                constant_values=-np.inf)
+    gp = np.zeros_like(xp)
     for oy in range(oh):
         for ox in range(ow):
             ys, xs = oy * s, ox * s
-            win = x[:, :, ys:ys + k, xs:xs + k]
+            win = xp[:, :, ys:ys + k, xs:xs + k]
             m = win.max(axis=(2, 3), keepdims=True)
-            gin[:, :, ys:ys + k, xs:xs + k] += np.where(
+            gp[:, :, ys:ys + k, xs:xs + k] += np.where(
                 win == m, g[:, :, oy:oy + 1, ox:ox + 1], 0.0)
-    return gin
+    return gp[:, :, pad:pad + h, pad:pad + w]
 
 
-def _grad(x, k, s):
+def _grad(x, k, s, pad=0):
     rng = np.random.RandomState(1)
-    oh, ow = pool_out_dim(x.shape[2], k, s), pool_out_dim(x.shape[3], k, s)
+    oh = pool_out_dim(x.shape[2], k, s, pad)
+    ow = pool_out_dim(x.shape[3], k, s, pad)
     g = rng.randn(x.shape[0], x.shape[1], oh, ow).astype(np.float32)
-    gr = jax.grad(lambda x: jnp.sum(pool2d(x, "max", k, k, s) * g))(
+    gr = jax.grad(
+        lambda x: jnp.sum(pool2d(x, "max", k, k, s, pad, pad) * g))(
         jnp.asarray(x))
     return np.asarray(gr), g
 
@@ -73,6 +78,17 @@ def test_distinct_values_match_xla_native_grad():
 
         nat = np.asarray(jax.grad(native)(jnp.asarray(x)))
         np.testing.assert_allclose(gr, nat, rtol=1e-6, atol=1e-7)
+
+
+def test_padded_pooling_matches_numpy_rule():
+    """pad > 0 (inception-style same-size pooling): ties + padding."""
+    rng = np.random.RandomState(4)
+    x = rng.randint(0, 3, (2, 2, 7, 7)).astype(np.float32)
+    for k, s, p in ((3, 1, 1), (3, 2, 1), (2, 2, 1)):
+        gr, g = _grad(x, k, s, p)
+        expect = numpy_unpool_grad(x, g, k, s, p)
+        np.testing.assert_allclose(gr, expect, rtol=1e-6, atol=1e-6,
+                                   err_msg=f"k={k} s={s} p={p}")
 
 
 def test_truncated_boundary_window():
