@@ -107,6 +107,13 @@ class NetTrainer:
             self.save_optimizer = int(val)
         if name == "shard_optimizer":
             self.shard_optimizer = int(val)
+        if name == "update_on_server" and int(val):
+            # reference knob (nnet_ps_server.cpp): run the updater on
+            # the PS instead of replicating it per worker. The TPU
+            # analog is sharding the optimizer state (docs/parallel.md).
+            # Enable-only: an explicit =0 (the reference default in
+            # non-PS configs) must not clobber shard_optimizer=1.
+            self.shard_optimizer = 1
         if name == "remat":
             self.remat = int(val)
         if name == "model_format":
@@ -286,6 +293,18 @@ class NetTrainer:
                       for name, _ in metric_specs]
         eval_train = bool(self.eval_train and metric_specs)
 
+        def metric_rows(outs, labels, mask, rng, base):
+            """Stacked (n_metrics, 2) device rows of (sum, count); the
+            single definition both the train and eval steps fold in."""
+            rows = []
+            for i, ((_, field), fn, (_, nid)) in enumerate(
+                    zip(metric_specs, metric_fns, self.eval_nodes)):
+                pred = outs[nid].reshape(outs[nid].shape[0], -1)
+                s, c = fn(pred, labels[field], mask,
+                          jax.random.fold_in(rng, base + i))
+                rows.append(jnp.stack([s, c]))
+            return jnp.stack(rows)
+
         from cxxnet_tpu.parallel.mesh import active_mesh
 
         def loss_fn(params, data, labels, mask, rng):
@@ -335,14 +354,8 @@ class NetTrainer:
                 (state["params"], state["ustate"], accum))
             tmetric = state["tmetric"]
             if eval_train:
-                rows = []
-                for i, ((_, field), fn, (_, nid)) in enumerate(
-                        zip(metric_specs, metric_fns, self.eval_nodes)):
-                    pred = outs[nid].reshape(outs[nid].shape[0], -1)
-                    s, c = fn(pred, labels[field], mask,
-                              jax.random.fold_in(rng, 1000 + i))
-                    rows.append(jnp.stack([s, c]))
-                tmetric = tmetric + jnp.stack(rows)
+                tmetric = tmetric + metric_rows(outs, labels, mask, rng,
+                                                1000)
             new_state = {
                 "params": params,
                 "ustate": ustate,
@@ -361,6 +374,16 @@ class NetTrainer:
             return {nid: values[nid].astype(jnp.float32)
                     for nid in range(net.cfg.num_nodes)
                     if values[nid] is not None}
+
+        def eval_metric_step(params, data, labels, mask, rng):
+            """Forward + per-batch metric rows fully on device: the eval
+            loop keeps the tiny (n_metrics, 2) results and sums them on
+            the host in float64 after the dataset - no per-batch
+            readback of node outputs (nnet_impl-inl.hpp:224-245 does
+            that on the host every batch) and no cross-batch f32
+            accumulation drift."""
+            outs = eval_step(params, data)
+            return metric_rows(outs, labels, mask, rng, 2000)
 
         rep, shd = self._replicated, self._batch_sharded
         # ustate prefix tree: one sharding per weight, prefixing the inner
@@ -391,6 +414,13 @@ class NetTrainer:
             donate_argnums=(0,))
         self._eval_step = jax.jit(
             eval_step, in_shardings=(self._pshard, shd), out_shardings=shd)
+        self._eval_metric_step = None
+        if metric_specs:
+            self._eval_metric_step = jax.jit(
+                eval_metric_step,
+                in_shardings=(self._pshard, shd, label_shardings, shd,
+                              rep),
+                out_shardings=rep)
 
     # ------------------------------------------------------------------
     # training api
@@ -485,7 +515,39 @@ class NetTrainer:
 
     def evaluate(self, data_iter, data_name: str) -> str:
         """Run eval metrics over an iterator; returns the reference-format
-        string `\\tname-metric:value...` (nnet_impl-inl.hpp:224-245)."""
+        string `\\tname-metric:value...` (nnet_impl-inl.hpp:224-245).
+
+        Metrics accumulate on device (one readback per dataset); the
+        host MetricSet path remains for metric-less trainers."""
+        from cxxnet_tpu.utils import metric_jit
+        specs = self.metric.specs
+        if self._eval_metric_step is not None:
+            shd = self._batch_sharded
+            per_batch = []  # tiny (n_metrics, 2) device arrays
+            data_iter.before_first()
+            step = 0
+            while data_iter.next():
+                batch = data_iter.value()
+                data, label, mask = self._pad_batch(batch)
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(self.seed + 200), step)
+                step += 1
+                labels = self._label_fields(label.astype(np.float32))
+                per_batch.append(self._eval_metric_step(
+                    self.state["params"],
+                    distributed.put_global(data.astype(np.float32), shd),
+                    {k: distributed.put_global(v, shd)
+                     for k, v in labels.items()},
+                    distributed.put_global(mask.astype(np.float32), shd),
+                    rng))
+            # host-side float64 reduction across batches (the host
+            # MetricSet path accumulated in f64; per-batch f32 sums are
+            # exact at batch scale, the cross-batch sum is not)
+            vals = np.zeros((len(specs), 2), np.float64)
+            for r in per_batch:
+                vals += np.asarray(distributed.fetch_local(r),
+                                   np.float64)
+            return metric_jit.format_metrics(data_name, specs, vals)
         self.metric.clear()
         data_iter.before_first()
         while data_iter.next():

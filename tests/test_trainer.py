@@ -286,6 +286,29 @@ def test_device_pruning_for_odd_batch():
     assert t.mesh.devices.size == 4
 
 
+def test_on_device_eval_metric_matches_host():
+    """evaluate()'s device-accumulated metrics == the host MetricSet
+    path on the same batches (incl. a short batch + num_batch_padd)."""
+    from cxxnet_tpu.utils.metric import MetricSet
+    t = make_trainer()
+    for b in synth_batches(3):
+        t.update(b)
+    batches = synth_batches(3, seed=5)
+    short = DataBatch(data=batches[0].data[:10],
+                      label=batches[0].label[:10], num_batch_padd=2)
+    evset = [batches[1], short]
+    out = t.evaluate(ListIter(evset), "ev")
+    dev_err = float(out.split(":")[-1])
+    host = MetricSet()
+    host.add_metric("error", "label")
+    for b in evset:
+        nvalid = b.batch_size - b.num_batch_padd
+        host.add_eval([t.predict_dist(b)[:nvalid]],
+                      {"label": b.label[:nvalid]})
+    assert abs(dev_err - host._metrics[0].get()) < 1e-6, out
+    assert out.startswith("\tev-error:")
+
+
 def test_on_device_train_metric_matches_host():
     """The jitted (sum,count) accumulation == the host MetricSet on the
     same forward outputs (update_period=2 so the first update leaves the
