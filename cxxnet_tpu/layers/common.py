@@ -480,6 +480,15 @@ class BatchNormLayer(Layer):
     (there is no running mean/var; its eval branch is just an algebraic
     rearrangement of the train branch). We preserve that quirk: train and
     eval compute identically.
+
+    Data-parallel stats parity: the reference normalizes each device's
+    batch slice with that slice's OWN statistics (each GPU runs its own
+    BN). A naive jnp.mean over the sharded batch dim would instead make
+    GSPMD insert an AllReduce per BN layer (global "sync-BN" stats +
+    collective latency in every forward/backward). Default behavior
+    computes per-shard stats inside a shard_map over the 'data' axis -
+    reference semantics, zero collectives; `global_stats = 1` opts into
+    the sync-BN extension.
     """
 
     type_name = "batch_norm"
@@ -489,6 +498,7 @@ class BatchNormLayer(Layer):
         self.init_slope = 1.0
         self.init_bias = 0.0
         self.eps = 1e-10
+        self.global_stats = 0
 
     def set_param(self, name: str, val: str) -> None:
         super().set_param(name, val)
@@ -498,6 +508,8 @@ class BatchNormLayer(Layer):
             self.init_bias = float(val)
         if name == "eps":
             self.eps = float(val)
+        if name == "global_stats":
+            self.global_stats = int(val)
 
     def _axes(self, shape: Shape):
         # conv node: stats over (b, h, w) per channel; matrix node: over b
@@ -523,19 +535,33 @@ class BatchNormLayer(Layer):
     def model_shard_dims(self) -> Dict[str, int]:
         return {"slope": 0, "bias": 0}
 
-    def apply(self, params, inputs, *, train, rng=None):
-        x = inputs[0]
+    def _normalize(self, x, slope, bias):
         axes, _ = self._axes(x.shape)
         mean = jnp.mean(x, axis=axes, keepdims=True)
         var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
         xhat = (x - mean) / jnp.sqrt(var + self.eps)
         if x.shape[1] != 1:
-            slope = params["slope"][None, :, None, None]
-            bias = params["bias"][None, :, None, None]
-        else:
-            slope = params["slope"][None, None, None, :]
-            bias = params["bias"][None, None, None, :]
-        return [xhat * slope + bias]
+            return xhat * slope[None, :, None, None] \
+                + bias[None, :, None, None]
+        return xhat * slope[None, None, None, :] \
+            + bias[None, None, None, :]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        slope, bias = params["slope"], params["bias"]
+        from cxxnet_tpu.parallel.mesh import get_active_mesh
+        mesh = get_active_mesh()
+        if (not self.global_stats and mesh is not None
+                and mesh.shape.get("data", 1) > 1
+                and x.shape[0] % mesh.shape["data"] == 0):
+            from jax.sharding import PartitionSpec as P
+            spec = P("data", *(None,) * (x.ndim - 1))
+            out = jax.shard_map(
+                self._normalize, mesh=mesh,
+                in_specs=(spec, P(), P()), out_specs=spec,
+                check_vma=False)(x, slope, bias)
+            return [out]
+        return [self._normalize(x, slope, bias)]
 
 
 @register_layer

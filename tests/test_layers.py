@@ -242,6 +242,32 @@ def test_batch_norm_eval_still_uses_batch_stats():
     np.testing.assert_allclose(out_train, out_eval, rtol=1e-6)
 
 
+def test_batch_norm_per_shard_stats_on_data_mesh():
+    """Under data parallelism BN uses each shard's OWN statistics (the
+    reference's per-GPU behavior) with no cross-device collective;
+    global_stats=1 opts into whole-batch sync-BN."""
+    from cxxnet_tpu.parallel.mesh import MeshSpec, active_mesh, build_mesh
+    rng = np.random.RandomState(9)
+    x = rng.randn(8, 3, 4, 4).astype(np.float32)
+    mesh = build_mesh(MeshSpec(device_indices=list(range(4))), 8)
+
+    layer = make("batch_norm", [("eps", "1e-5")])
+    with active_mesh(mesh):
+        (out,), p = run(layer, [x])
+    # shard i (2 rows) == BN of those rows alone
+    for i in range(4):
+        (solo,), _ = run(make("batch_norm", [("eps", "1e-5")]),
+                         [x[2 * i:2 * i + 2]])
+        np.testing.assert_allclose(out[2 * i:2 * i + 2], solo,
+                                   rtol=1e-4, atol=1e-5)
+
+    sync = make("batch_norm", [("eps", "1e-5"), ("global_stats", "1")])
+    with active_mesh(mesh):
+        (out_sync,), _ = run(sync, [x])
+    (whole,), _ = run(make("batch_norm", [("eps", "1e-5")]), [x])
+    np.testing.assert_allclose(out_sync, whole, rtol=1e-4, atol=1e-5)
+
+
 def test_batch_norm_fc_normalizes_features():
     rng = np.random.RandomState(9)
     x = rng.randn(16, 1, 1, 6).astype(np.float32)
