@@ -549,16 +549,28 @@ class BatchNormLayer(Layer):
     def apply(self, params, inputs, *, train, rng=None):
         x = inputs[0]
         slope, bias = params["slope"], params["bias"]
-        from cxxnet_tpu.parallel.mesh import get_active_mesh
+        from cxxnet_tpu.parallel.mesh import batch_shardable, \
+            get_active_mesh
         mesh = get_active_mesh()
-        if (not self.global_stats and mesh is not None
-                and mesh.shape.get("data", 1) > 1
-                and x.shape[0] % mesh.shape["data"] == 0):
+        if not self.global_stats and batch_shardable(mesh, x.shape[0]):
             from jax.sharding import PartitionSpec as P
-            spec = P("data", *(None,) * (x.ndim - 1))
+            # channels are independent of the stats reduction, so the
+            # channel dim additionally rides 'model' when the params do
+            # (mirrors shardings_for's divisibility rule) - under TP the
+            # BN then needs NO collectives at all instead of gathering
+            # channel-sharded activations
+            cdim = 1 if x.shape[1] != 1 else 3
+            msize = mesh.shape.get("model", 1)
+            axes = [None] * x.ndim
+            axes[0] = "data"
+            pspec = P()
+            if msize > 1 and x.shape[cdim] % msize == 0:
+                axes[cdim] = "model"
+                pspec = P("model")
+            spec = P(*axes)
             out = jax.shard_map(
                 self._normalize, mesh=mesh,
-                in_specs=(spec, P(), P()), out_specs=spec,
+                in_specs=(spec, pspec, pspec), out_specs=spec,
                 check_vma=False)(x, slope, bias)
             return [out]
         return [self._normalize(x, slope, bias)]

@@ -320,6 +320,10 @@ class LearnTask:
                 sys.stderr.write("\n")
                 sys.stderr.flush()
             self._save_model()
+        final_profile = self.net_trainer.profile_summary()
+        if final_profile:
+            sys.stderr.write(final_profile + "\n")
+            sys.stderr.flush()
         if not self.silent:
             print(f"\nupdating end, {int(time.time() - start)} sec in all")
 

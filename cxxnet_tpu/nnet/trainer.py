@@ -427,9 +427,26 @@ class NetTrainer:
     # ------------------------------------------------------------------
     def start_round(self, round_counter: int) -> None:
         self.round = round_counter
+        if self.profiler is not None:
+            # close out + report the previous round's profile, then arm
+            # the next (the first profiled round also dumps the trace)
+            if self.profiler.step_s:
+                sys.stderr.write(self.profiler.summary() + "\n")
+            self.profiler.round_end()
+            self.profiler.round_start()
         for layer in (self.net.layer_objs if self.net else []):
             if hasattr(layer, "anneal_step"):
                 layer.anneal_step()
+
+    def profile_summary(self) -> str:
+        """Summary line for the round in progress ('' when profiling is
+        off or no steps ran); closes any open trace either way."""
+        if self.profiler is None:
+            return ""
+        self.profiler.round_end()
+        if not self.profiler.step_s:
+            return ""
+        return self.profiler.summary()
 
     @property
     def _local_batch(self) -> int:

@@ -146,10 +146,9 @@ def use_pallas_lrn_sharded(x: jax.Array, mesh) -> bool:
     communication; each device runs the kernel on its local shard.
     Requires the per-shard batch to be whole and the channel tiling
     constraint on the (unchanged) per-shard channel dim."""
-    if not _backend_ok() or mesh is None or "data" not in mesh.axis_names:
-        return False
-    ndata = mesh.shape["data"]
-    return x.shape[0] % ndata == 0 and _tile_ok(x)
+    from cxxnet_tpu.parallel.mesh import batch_shardable
+    return (_backend_ok() and batch_shardable(mesh, x.shape[0])
+            and _tile_ok(x))
 
 
 def lrn_pallas_sharded(x, mesh, local_size, alpha, beta, knorm):

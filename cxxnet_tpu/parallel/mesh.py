@@ -48,6 +48,20 @@ def get_active_mesh() -> Optional[Mesh]:
     return _ACTIVE_MESH[-1]
 
 
+def data_axis_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return mesh.shape.get("data", 1)
+
+
+def batch_shardable(mesh: Optional[Mesh], batch: int) -> bool:
+    """Shared eligibility for shard_map-over-'data' op routes (Pallas
+    LRN, per-shard batch_norm): a real data axis whose size divides the
+    batch dim."""
+    n = data_axis_size(mesh)
+    return n > 1 and batch % n == 0
+
+
 @dataclass
 class MeshSpec:
     device_indices: Optional[List[int]] = None  # None = single device

@@ -105,6 +105,16 @@ def test_cli_train_reaches_high_accuracy(dataset, capfd):
     assert full.splitlines()[-1].startswith("[6]")
 
 
+def test_cli_profile_mode(dataset, capfd):
+    """profile=1 prints per-round step-time summaries to stderr."""
+    tmp_path, conf = dataset
+    LearnTask().run([conf, "profile=1", "num_round=2", "save_model=0"])
+    err = capfd.readouterr().err
+    lines = [l for l in err.splitlines() if "profile:" in l]
+    assert len(lines) >= 2, err  # one per round
+    assert "images/sec" in lines[-1]
+
+
 def test_cli_test_on_server_check(dataset, capfd):
     """test_on_server=1 runs the per-round replicated-weight consistency
     check (CheckWeight_ analog, async_updater-inl.hpp:144-153)."""
