@@ -301,6 +301,7 @@ class LearnTask:
                     elapsed = int(time.time() - start)
                     print(f"round {self.start_counter - 1:8d}:"
                           f"[{sample_counter:8d}] {elapsed} sec elapsed")
+            self.net_trainer.finish_round_profile()
             if self.test_on_server:
                 # CheckWeight_ analog (async_updater-inl.hpp:144-153):
                 # every round, verify that replicated weights really are

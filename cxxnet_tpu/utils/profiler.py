@@ -12,8 +12,7 @@ Two levels:
 
 from __future__ import annotations
 
-import time
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 
@@ -69,10 +68,3 @@ class StepProfiler:
         if self.trace_dir:
             out += f", trace -> {self.trace_dir}"
         return out
-
-
-def timed(fn, *args, **kwargs):
-    """(result, seconds) of a host call."""
-    t0 = time.perf_counter()
-    out = fn(*args, **kwargs)
-    return out, time.perf_counter() - t0

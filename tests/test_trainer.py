@@ -229,6 +229,20 @@ def test_data_parallel_multi_device_matches_single():
         np.asarray(t8.state["params"]["fc1"]["wmat"]), rtol=2e-4, atol=1e-5)
 
 
+def test_bfloat16_host_cast_input_path():
+    """dtype=bfloat16 stages bf16 inputs from the host (half the H2D
+    bytes); training, eval and predict all run through it."""
+    import ml_dtypes
+    t = make_trainer(extra="dtype = bfloat16\n")
+    assert t._host_input(np.ones((2, 1), np.float32)).dtype \
+        == ml_dtypes.bfloat16
+    b = synth_batches(1)[0]
+    t.update(b)
+    out = t.evaluate(ListIter([b]), "e")
+    assert np.isfinite(float(out.split(":")[-1]))
+    assert t.predict(b).shape == (16,)
+
+
 def test_remat_matches_plain():
     """remat=1 (jax.checkpoint over the forward) changes memory, not
     math: training trajectories are identical."""
