@@ -4,6 +4,7 @@ from cxxnet_tpu.layers.base import (
     LAYER_REGISTRY, Layer, LayerParam, create_layer, is_mat,
     known_layer_types, register_layer)
 # importing the modules populates the registry
+from cxxnet_tpu.layers import attention as _attention  # noqa: F401
 from cxxnet_tpu.layers import common as _common  # noqa: F401
 from cxxnet_tpu.layers import loss as _loss  # noqa: F401
 from cxxnet_tpu.layers import pairtest as _pairtest  # noqa: F401

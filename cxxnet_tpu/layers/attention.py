@@ -1,0 +1,239 @@
+"""Sequence layers: attention, layernorm, pos_embed.
+
+Pure TPU-native extension surface - the reference has no sequence models
+(SURVEY.md: cxxnet predates attention; CNN/MLP only), but this framework
+treats long-context as first-class, so the config language gains a
+minimal transformer vocabulary over "sequence nodes" of shape
+(batch, 1, seq, embed) - the NCHW matrix convention (layer.h:33-54)
+extended with a real y dim as sequence.
+
+attention  multi-head self-attention. Params: qkv projection `wmat`
+           (3*embed, embed) and output projection `wproj` (embed, embed),
+           optional `bias` (3*embed,). Tensor parallelism shards wmat
+           rows / wproj columns over 'model' (Megatron-style); sequence
+           parallelism routes the core through ring or Ulysses attention
+           (parallel/ring.py) whenever the active mesh has a 'seq' axis -
+           `seq_parallel = ring|ulysses|none` overrides the default
+           (ring). `causal = 1` masks the future; `nhead` sets heads.
+layernorm  per-position normalization over the embed dim with learnable
+           slope/bias - the sequence-model norm (batch_norm's per-batch
+           statistics break under variable batch composition).
+pos_embed  learned additive positional embedding (seq, embed).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.layers.base import Layer, Params, Shape, register_layer
+from cxxnet_tpu.ops import attention as ops_attn
+
+
+@register_layer
+class AttentionLayer(Layer):
+    """Multi-head self-attention on (b, 1, s, e) sequence nodes."""
+
+    type_name = "attention"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.nhead = 1
+        self.causal = 0
+        self.seq_parallel = "ring"
+        self.kv_block = 512
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "nhead":
+            self.nhead = int(val)
+        if name == "causal":
+            self.causal = int(val)
+        if name == "seq_parallel":
+            if val not in ("ring", "ulysses", "none"):
+                raise ValueError(
+                    "seq_parallel must be ring, ulysses or none")
+            self.seq_parallel = val
+        if name == "kv_block":
+            self.kv_block = int(val)
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        b, c, s, e = in_shapes[0]
+        if c != 1:
+            raise ValueError(
+                "AttentionLayer: input must be a sequence node "
+                f"(b,1,seq,embed); got channel={c}")
+        if e % self.nhead != 0:
+            raise ValueError(
+                f"AttentionLayer: embed {e} not divisible by "
+                f"nhead {self.nhead}")
+        return [in_shapes[0]]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        e = in_shapes[0][3]
+        k1, k2 = jax.random.split(key)
+        wmat = self.param.rand_init_weight(k1, (3 * e, e),
+                                           in_num=e, out_num=3 * e)
+        wproj = self.param.rand_init_weight(k2, (e, e),
+                                            in_num=e, out_num=e)
+        params = {"wmat": wmat, "wproj": wproj}
+        if self.param.no_bias == 0:
+            params["bias"] = jnp.full((3 * e,), self.param.init_bias,
+                                      dtype=jnp.float32)
+        return params
+
+    def param_tags(self) -> Dict[str, str]:
+        return {"wmat": "wmat", "wproj": "wmat", "bias": "bias"}
+
+    def model_shard_dims(self) -> Dict[str, int]:
+        # qkv rows are per-head blocks (column parallel); the output
+        # projection contracts the head dim, so its COLUMNS shard
+        # (row parallel) and XLA closes with one all-reduce
+        return {"wmat": 0, "bias": 0, "wproj": 1}
+
+    def _core(self, q, k, v):
+        """Route the attention core by the active mesh (same pattern as
+        the Pallas LRN route, ops/nn.py): ring/ulysses under a 'seq'
+        axis, blockwise otherwise."""
+        from cxxnet_tpu.parallel import ring as R
+        from cxxnet_tpu.parallel.mesh import get_active_mesh
+        mesh = get_active_mesh()
+        causal = bool(self.causal)
+        if (self.seq_parallel != "none" and mesh is not None
+                and R.ring_eligible(mesh, q.shape[2])):
+            if self.seq_parallel == "ulysses":
+                return R.ulysses_attention(q, k, v, mesh, causal=causal,
+                                           kv_block=self.kv_block)
+            return R.ring_attention(q, k, v, mesh, causal=causal)
+        return ops_attn.blockwise_attention(q, k, v, causal=causal,
+                                            kv_block=self.kv_block)
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        b, _, s, e = x.shape
+        h = self.nhead
+        xs = x.reshape(b, s, e)
+        qkv = jnp.einsum("bse,fe->bsf", xs, params["wmat"])
+        if "bias" in params:
+            qkv = qkv + params["bias"][None, None, :]
+        # (b, s, 3e) -> 3 x (b, h, s, e/h)
+        qkv = qkv.reshape(b, s, 3, h, e // h)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+        o = self._core(q, k, v)
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s, e)
+        out = jnp.einsum("bsf,ef->bse", o, params["wproj"])
+        return [out.reshape(b, 1, s, e)]
+
+
+@register_layer
+class SeqFullcLayer(Layer):
+    """seq_fullc: position-wise fully-connected on (b, 1, s, e) sequence
+    nodes -> (b, 1, s, nhidden); the transformer FFN building block.
+    Kept separate from fullc so the reference layer's matrix-node
+    requirement (fullc_layer-inl.hpp) still errors on misshaped nets."""
+
+    type_name = "seq_fullc"
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        b, c, s, e = in_shapes[0]
+        if c != 1:
+            raise ValueError("seq_fullc: input must be a sequence node")
+        if self.param.num_hidden <= 0:
+            raise ValueError("seq_fullc: must set nhidden correctly")
+        self.param.num_input_node = e
+        return [(b, 1, s, self.param.num_hidden)]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        e = in_shapes[0][3]
+        nh = self.param.num_hidden
+        params = {"wmat": self.param.rand_init_weight(
+            key, (nh, e), in_num=e, out_num=nh)}
+        if self.param.no_bias == 0:
+            params["bias"] = jnp.full((nh,), self.param.init_bias,
+                                      dtype=jnp.float32)
+        return params
+
+    def param_tags(self) -> Dict[str, str]:
+        return {"wmat": "wmat", "bias": "bias"}
+
+    def model_shard_dims(self) -> Dict[str, int]:
+        return {"wmat": 0, "bias": 0}
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        b, _, s, e = x.shape
+        out = jnp.einsum("bse,fe->bsf", x.reshape(b, s, e),
+                         params["wmat"])
+        if "bias" in params:
+            out = out + params["bias"][None, None, :]
+        return [out.reshape(b, 1, s, -1)]
+
+
+@register_layer
+class LayerNormLayer(Layer):
+    """Per-position layer normalization over the last (embed) dim."""
+
+    type_name = "layernorm"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.eps = 1e-5
+        self.init_slope = 1.0
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "eps":
+            self.eps = float(val)
+        if name == "init_slope":
+            self.init_slope = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        return [in_shapes[0]]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        e = in_shapes[0][3]
+        return {"slope": jnp.full((e,), self.init_slope, jnp.float32),
+                "bias": jnp.full((e,), self.param.init_bias, jnp.float32)}
+
+    def param_tags(self) -> Dict[str, str]:
+        # same visitor tags as batch_norm: slope under wmat, bias under
+        # bias (bn_layer-inl.hpp ApplyVisitor convention)
+        return {"slope": "wmat", "bias": "bias"}
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["slope"] + params["bias"]
+        return [y.astype(x.dtype)]
+
+
+@register_layer
+class PosEmbedLayer(Layer):
+    """Learned additive positional embedding on (b, 1, s, e) nodes."""
+
+    type_name = "pos_embed"
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        return [in_shapes[0]]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        _, _, s, e = in_shapes[0]
+        wmat = self.param.rand_init_weight(key, (s, e), in_num=e,
+                                           out_num=e)
+        return {"wmat": wmat}
+
+    def param_tags(self) -> Dict[str, str]:
+        return {"wmat": "wmat"}
+
+    def apply(self, params, inputs, *, train, rng=None):
+        x = inputs[0]
+        return [x + params["wmat"][None, None, :, :].astype(x.dtype)]

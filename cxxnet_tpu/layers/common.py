@@ -736,3 +736,28 @@ class ChConcatLayer(ConcatBase):
     """ch_concat along the channel dim (concat_layer-inl.hpp, dim=1)."""
     type_name = "ch_concat"
     dim = 1
+
+
+@register_layer
+class AddLayer(Layer):
+    """add: elementwise sum of N same-shape inputs (no reference analog -
+    extension enabling residual connections, e.g. transformer blocks in
+    layers/attention.py; autodiff broadcasts the output grad to every
+    input, the textbook residual backward)."""
+
+    type_name = "add"
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        if len(in_shapes) < 2:
+            raise ValueError("add layer needs at least 2 inputs")
+        for s in in_shapes[1:]:
+            if tuple(s) != tuple(in_shapes[0]):
+                raise ValueError(
+                    f"add: input shapes differ: {in_shapes}")
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, *, train, rng=None):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out]

@@ -65,6 +65,12 @@ class LearnTask:
             if "=" in arg:
                 name, val = arg.split("=", 1)
                 self.set_param(name.strip(), val.strip())
+        # an explicit JAX_PLATFORMS env always beats the conf's `dev`
+        # kind (which is advisory - parallel/mesh.py): without this, a
+        # `dev = tpu` conf run under JAX_PLATFORMS=cpu still initializes
+        # every registered plugin and can hang on an absent tunnel
+        from cxxnet_tpu.utils.platform import ensure_env_platform
+        ensure_env_platform()
         if self.device.split(":")[0] == "cpu":
             # honor `dev = cpu` before any backend is touched: skip
             # accelerator-platform init entirely (matters when the TPU

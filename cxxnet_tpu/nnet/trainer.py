@@ -263,6 +263,16 @@ class NetTrainer:
     def _batch_sharded(self):
         return NamedSharding(self.mesh, P("data"))
 
+    @property
+    def _data_sharded(self):
+        """Input-tensor sharding: batch over 'data' and, for sequence
+        models on a mesh with a 'seq' axis, the sequence (y) dim over
+        'seq' (parallel/ring.py). Labels/mask stay batch-only."""
+        nseq = self.mesh.shape.get("seq", 1)
+        if nseq > 1 and self.net_cfg.input_shape[1] % nseq == 0:
+            return NamedSharding(self.mesh, P("data", None, "seq", None))
+        return self._batch_sharded
+
     def _label_fields(self, label: np.ndarray) -> Dict[str, np.ndarray]:
         fields = {}
         for fname, idx in self.net_cfg.label_name_map.items():
@@ -395,6 +405,7 @@ class NetTrainer:
             return metric_rows(outs, labels, mask, rng, 2000)
 
         rep, shd = self._replicated, self._batch_sharded
+        dshd = self._data_sharded
         # ustate prefix tree: one sharding per weight, prefixing the inner
         # updater-state dict ({m} / {m1,m2}); mirrors _init_state's filter
         ushard = self._pshard
@@ -418,16 +429,18 @@ class NetTrainer:
             f: shd for f in self.net_cfg.label_name_map}
         self._train_step = jax.jit(
             train_step,
-            in_shardings=(state_shardings, shd, label_shardings, shd, rep),
+            in_shardings=(state_shardings, dshd, label_shardings, shd,
+                          rep),
             out_shardings=(state_shardings, rep),
             donate_argnums=(0,))
         self._eval_step = jax.jit(
-            eval_step, in_shardings=(self._pshard, shd), out_shardings=shd)
+            eval_step, in_shardings=(self._pshard, dshd),
+            out_shardings=shd)
         self._eval_metric_step = None
         if metric_specs:
             self._eval_metric_step = jax.jit(
                 eval_metric_step,
-                in_shardings=(self._pshard, shd, label_shardings, shd,
+                in_shardings=(self._pshard, dshd, label_shardings, shd,
                               rep),
                 out_shardings=rep)
 
@@ -509,7 +522,8 @@ class NetTrainer:
         self._step_counter += 1
         labels = self._label_fields(label.astype(np.float32))
         shd = self._batch_sharded
-        gdata = distributed.put_global(self._host_input(data), shd)
+        gdata = distributed.put_global(self._host_input(data),
+                                       self._data_sharded)
         glabels = {k: distributed.put_global(v, shd)
                    for k, v in labels.items()}
         gmask = distributed.put_global(mask.astype(np.float32), shd)
@@ -548,7 +562,7 @@ class NetTrainer:
     def _forward_nodes(self, batch: DataBatch) -> Dict[int, np.ndarray]:
         data, _, mask = self._pad_batch(batch)
         gdata = distributed.put_global(self._host_input(data),
-                                       self._batch_sharded)
+                                       self._data_sharded)
         outs = self._eval_step(self.state["params"], gdata)
         valid = int(mask.sum())
         return {nid: distributed.fetch_local(v)[:valid]
@@ -576,7 +590,8 @@ class NetTrainer:
                 labels = self._label_fields(label.astype(np.float32))
                 per_batch.append(self._eval_metric_step(
                     self.state["params"],
-                    distributed.put_global(self._host_input(data), shd),
+                    distributed.put_global(self._host_input(data),
+                                           self._data_sharded),
                     {k: distributed.put_global(v, shd)
                      for k, v in labels.items()},
                     distributed.put_global(mask.astype(np.float32), shd),

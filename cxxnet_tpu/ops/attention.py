@@ -1,0 +1,154 @@
+"""Scaled-dot-product attention ops: naive, blockwise (flash-style), and
+the partial/merge primitives ring attention is built from.
+
+The reference has no attention (cxxnet predates it - SURVEY.md notes
+sequence models are absent), so this module is pure TPU-native extension
+surface: it exists so the framework's long-context story (ring /
+all-to-all sequence parallelism, parallel/ring.py) has a single-device
+ground truth and a memory-efficient local kernel.
+
+Layout convention: [batch, heads, seq, head_dim] (BHSD). All softmax
+arithmetic runs in float32 regardless of input dtype (bf16 scores lose
+the softmax's dynamic range on TPU); the output is cast back to the
+query dtype.
+
+The blockwise form is the standard online-softmax recurrence: partial
+results are (acc, m, l) - unnormalized weighted values, running row max,
+running denominator - merged associatively, which is exactly what lets
+the ring variant accumulate across K/V blocks that arrive one ppermute
+step at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Finite stand-in for -inf in masked score entries: exp(x - m) with both
+# at -1e30 is exp(0)=1 only when ALL entries of a row are masked, and
+# such rows carry l=0 and are resolved by the caller (or cannot occur -
+# causal rows always see their own position). -inf itself would produce
+# inf-inf=nan in the max-subtraction.
+_NEG = -1e30
+
+
+def _scale(q, scale: Optional[float]) -> float:
+    return (1.0 / (q.shape[-1] ** 0.5)) if scale is None else scale
+
+
+def _causal_bias(sq: int, sk: int, q_offset, kv_offset) -> jax.Array:
+    """(sq, sk) additive bias: 0 where key position <= query position in
+    GLOBAL coordinates, _NEG elsewhere. Offsets may be traced values
+    (ring attention passes lax.axis_index-derived block offsets)."""
+    qpos = q_offset + jnp.arange(sq)[:, None]
+    kpos = kv_offset + jnp.arange(sk)[None, :]
+    return jnp.where(kpos <= qpos, 0.0, _NEG)
+
+
+def naive_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Reference semantics: softmax(q.k^T * scale [+ causal mask]).v with
+    the full (sq, sk) score matrix materialized. Ground truth for the
+    blockwise/ring variants' differential tests."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * _scale(q, scale)
+    if causal:
+        s = s + _causal_bias(q.shape[2], k.shape[2], 0, 0)[None, None]
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o.astype(q.dtype)
+
+
+def attention_partial(q, k, v, *, scale: Optional[float] = None,
+                      causal: bool = False, q_offset=0, kv_offset=0,
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One K/V block's contribution as an online-softmax partial.
+
+    Returns (acc [B,H,Sq,D] f32 unnormalized, m [B,H,Sq] f32 row max,
+    l [B,H,Sq] f32 denominator). Offsets place the blocks on the global
+    sequence for causal masking (traced values allowed)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * _scale(q, scale)
+    if causal:
+        s = s + _causal_bias(q.shape[2], k.shape[2],
+                             q_offset, kv_offset)[None, None]
+    m = jnp.max(s, axis=-1)
+    # keep fully-masked rows finite: their p rows are exp(_NEG - _NEG)=1
+    # scaled below by where(), so force p=0 via the mask itself
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s <= _NEG * 0.5, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return acc, m, l
+
+
+def merge_partials(a: Tuple[jax.Array, jax.Array, jax.Array],
+                   b: Tuple[jax.Array, jax.Array, jax.Array],
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Associative merge of two online-softmax partials."""
+    acc_a, m_a, l_a = a
+    acc_b, m_b, l_b = b
+    m = jnp.maximum(m_a, m_b)
+    ca = jnp.exp(m_a - m)
+    cb = jnp.exp(m_b - m)
+    acc = acc_a * ca[..., None] + acc_b * cb[..., None]
+    l = l_a * ca + l_b * cb
+    return acc, m, l
+
+
+def finalize_partial(acc, l, dtype) -> jax.Array:
+    """acc/l with fully-masked rows (l=0) resolved to 0."""
+    safe = jnp.where(l > 0, l, 1.0)
+    return (acc / safe[..., None]).astype(dtype)
+
+
+def empty_partial(q) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    b, h, sq, d = q.shape
+    return (jnp.zeros((b, h, sq, d), jnp.float32),
+            jnp.full((b, h, sq), _NEG, jnp.float32),
+            jnp.zeros((b, h, sq), jnp.float32))
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None,
+                        kv_block: int = 512):
+    """Flash-style memory-efficient attention: lax.scan over K/V blocks
+    with the online-softmax recurrence; peak score memory is
+    (Sq, kv_block) instead of (Sq, Sk). Semantics == naive_attention.
+
+    The scan carries f32 (acc, m, l); XLA keeps the whole loop on-chip.
+    Wrap in jax.checkpoint (remat=1) for the O(S) memory backward."""
+    sk = k.shape[2]
+    kv_block = min(kv_block, sk)
+    if sk % kv_block != 0:
+        # static shapes: use the largest divisor <= kv_block so the
+        # O(Sq x kv_block) score-memory bound survives any block size
+        # (falling back to one full block would defeat the point at
+        # exactly the long sequences this exists for)
+        kv_block = next(b for b in range(kv_block, 0, -1) if sk % b == 0)
+    nblk = sk // kv_block
+    if nblk == 1:
+        acc, m, l = attention_partial(q, k, v, scale=scale, causal=causal)
+        return finalize_partial(acc, l, q.dtype)
+
+    kb = k.reshape(k.shape[0], k.shape[1], nblk, kv_block, k.shape[3])
+    vb = v.reshape(v.shape[0], v.shape[1], nblk, kv_block, v.shape[3])
+    kb = jnp.moveaxis(kb, 2, 0)   # [nblk, B, H, kv_block, D]
+    vb = jnp.moveaxis(vb, 2, 0)
+
+    def step(carry, xs):
+        kv_i, k_i, v_i = xs
+        part = attention_partial(q, k_i, v_i, scale=scale, causal=causal,
+                                 q_offset=0, kv_offset=kv_i * kv_block)
+        return merge_partials(carry, part), None
+
+    init = empty_partial(q)
+    (acc, _, l), _ = lax.scan(step, init, (jnp.arange(nblk), kb, vb))
+    return finalize_partial(acc, l, q.dtype)

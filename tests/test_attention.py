@@ -1,0 +1,158 @@
+"""Attention ops + sequence parallelism differential tests.
+
+Ground truth is ops.attention.naive_attention on one device; the
+blockwise, ring (shard_map + ppermute over 'seq') and Ulysses
+(all_to_all) variants must match it in forward AND gradient - sequence
+parallelism changes the schedule, never the math (same invariant as the
+TP tests).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cxxnet_tpu.ops import attention as A
+from cxxnet_tpu.parallel import ring as R
+
+
+def _qkv(b=2, h=4, s=16, d=8, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, h, s, d).astype(dtype)  # noqa: E731
+    return jnp.asarray(mk()), jnp.asarray(mk()), jnp.asarray(mk())
+
+
+def _grads(fn, q, k, v):
+    return jax.grad(lambda q, k, v: jnp.sum(jnp.cos(fn(q, k, v))),
+                    argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("kv_block", [4, 16, 5])
+def test_blockwise_matches_naive(causal, kv_block):
+    q, k, v = _qkv()
+    ref = A.naive_attention(q, k, v, causal=causal)
+    out = A.blockwise_attention(q, k, v, causal=causal, kv_block=kv_block)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    gr = _grads(lambda *a: A.naive_attention(*a, causal=causal), q, k, v)
+    gb = _grads(lambda *a: A.blockwise_attention(
+        *a, causal=causal, kv_block=kv_block), q, k, v)
+    for a, b in zip(gr, gb):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_partial_merge_is_order_insensitive():
+    q, k, v = _qkv(s=12)
+    p1 = A.attention_partial(q, k[:, :, :4], v[:, :, :4])
+    p2 = A.attention_partial(q, k[:, :, 4:], v[:, :, 4:])
+    ref = A.naive_attention(q, k, v)
+    for first, second in ((p1, p2), (p2, p1)):
+        acc, _, l = A.merge_partials(first, second)
+        out = A.finalize_partial(acc, l, q.dtype)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_fully_masked_rows_are_zero_and_nan_free():
+    """A partial whose K/V block is entirely in the causal future must
+    yield l=0 rows that finalize to 0 (the ring hits this every step)."""
+    q, k, v = _qkv(s=4)
+    acc, m, l = A.attention_partial(q, k, v, causal=True,
+                                    q_offset=0, kv_offset=100)
+    assert np.all(np.asarray(l) == 0.0)
+    out = A.finalize_partial(acc, l, q.dtype)
+    assert np.all(np.asarray(out) == 0.0)
+    # and merging it with a real partial must not disturb the result
+    real = A.attention_partial(q, k, v, causal=True)
+    ref = A.finalize_partial(real[0], real[2], q.dtype)
+    acc2, _, l2 = A.merge_partials((acc, m, l), real)
+    out2 = A.finalize_partial(acc2, l2, q.dtype)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _mesh(axes):
+    names = [a for a, _ in axes]
+    sizes = [n for _, n in axes]
+    devs = np.asarray(jax.devices()[:int(np.prod(sizes))]).reshape(sizes)
+    return Mesh(devs, tuple(names))
+
+
+def _put(mesh, spec, *arrays):
+    s = NamedSharding(mesh, spec)
+    return tuple(jax.device_put(a, s) for a in arrays)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("axes", [[("seq", 8)],
+                                  [("data", 2), ("seq", 4)],
+                                  [("data", 2), ("model", 2), ("seq", 2)]])
+def test_ring_matches_naive(causal, axes):
+    mesh = _mesh(axes)
+    q, k, v = _qkv(b=2, h=4, s=16, d=8)
+    ref = A.naive_attention(q, k, v, causal=causal)
+    spec = R._bhsd_spec(mesh, 4)
+    qs, ks, vs = _put(mesh, spec, q, k, v)
+    out = R.ring_attention(qs, ks, vs, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gradients_match(causal):
+    mesh = _mesh([("seq", 4)])
+    q, k, v = _qkv(b=1, h=2, s=8, d=4)
+    gr = _grads(lambda *a: A.naive_attention(*a, causal=causal), q, k, v)
+    gg = _grads(lambda *a: R.ring_attention(*a, mesh, causal=causal),
+                q, k, v)
+    for a, b in zip(gr, gg):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("axes", [[("seq", 4)],
+                                  [("data", 2), ("seq", 4)]])
+def test_ulysses_matches_naive(causal, axes):
+    mesh = _mesh(axes)
+    q, k, v = _qkv(b=2, h=4, s=16, d=8)
+    ref = A.naive_attention(q, k, v, causal=causal)
+    spec = R._bhsd_spec(mesh, 4)
+    qs, ks, vs = _put(mesh, spec, q, k, v)
+    out = R.ulysses_attention(qs, ks, vs, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_gradients_match():
+    mesh = _mesh([("seq", 4)])
+    q, k, v = _qkv(b=1, h=4, s=8, d=4)
+    gr = _grads(lambda *a: A.naive_attention(*a, causal=True), q, k, v)
+    gu = _grads(lambda *a: R.ulysses_attention(*a, mesh, causal=True),
+                q, k, v)
+    for a, b in zip(gr, gu):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = _mesh([("seq", 8)])
+    q, k, v = _qkv(b=1, h=4, s=16, d=4)
+    with pytest.raises(ValueError, match="divisible"):
+        R.ulysses_attention(q, k, v, mesh)
+
+
+def test_bf16_inputs_stay_stable():
+    """Softmax arithmetic is f32 even for bf16 tensors; results must be
+    close to the f32 reference at bf16 resolution."""
+    q, k, v = _qkv(s=16)
+    ref = A.naive_attention(q, k, v, causal=True)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = A.blockwise_attention(qb, kb, vb, causal=True, kv_block=4)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=0.05, atol=0.05)

@@ -1,0 +1,149 @@
+"""Transformer-block training through the trainer, with and without
+sequence parallelism.
+
+The invariant mirrors the TP tests (test_tensor_parallel.py): a mesh
+with a 'seq' axis (ring attention inside the jitted step) must train to
+numerically-identical weights as a single-device run (blockwise
+attention) - sequence parallelism changes the schedule, never the math.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.layers import create_layer
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+# one pre-norm residual transformer block + classifier head over
+# sequence nodes (b, 1, seq=8, embed=16)
+TRANSFORMER_NET = """
+netconfig=start
+layer[0->1] = pos_embed:pe
+  init_sigma = 0.02
+layer[1->2,3] = split
+layer[2->4] = layernorm:ln1
+layer[4->5] = attention:att1
+  nhead = 2
+  causal = 1
+  init_sigma = 0.05
+layer[5,3->6] = add
+layer[6->7,8] = split
+layer[7->9] = layernorm:ln2
+layer[9->10] = seq_fullc:ffn1
+  nhidden = 32
+layer[10->11] = relu
+layer[11->12] = seq_fullc:ffn2
+  nhidden = 16
+layer[12,8->13] = add
+layer[13->14] = flatten
+layer[14->15] = fullc:head
+  nhidden = 4
+layer[15->15] = softmax
+netconfig=end
+input_shape = 1,8,16
+random_type = gaussian
+init_sigma = 0.05
+eta = 0.05
+momentum = 0.9
+batch_size = 8
+silent = 1
+eval_train = 0
+"""
+
+
+def _make(mesh: str, seq_parallel: str = "ring") -> NetTrainer:
+    t = NetTrainer()
+    for k, v in parse_config_string(
+            TRANSFORMER_NET.replace("= ring", f"= {seq_parallel}")):
+        t.set_param(k, v)
+    if mesh:
+        t.set_param("mesh", mesh)
+    t.init_model()
+    return t
+
+
+def _batches(n=3, b=8):
+    rng = np.random.RandomState(11)
+    return [DataBatch(
+        data=rng.randn(b, 1, 8, 16).astype(np.float32),
+        label=rng.randint(0, 4, size=(b, 1)).astype(np.float32))
+        for _ in range(n)]
+
+
+def _weights(t: NetTrainer):
+    return jax.tree.map(np.asarray, jax.device_get(t.state["params"]))
+
+
+def test_shapes_and_registry():
+    for name in ("attention", "layernorm", "pos_embed", "add"):
+        assert create_layer(name) is not None
+    att = create_layer("attention")
+    att.set_param("nhead", "4")
+    assert att.infer_shapes([(2, 1, 8, 16)]) == [(2, 1, 8, 16)]
+    with pytest.raises(ValueError, match="divisible"):
+        att2 = create_layer("attention")
+        att2.set_param("nhead", "3")
+        att2.infer_shapes([(2, 1, 8, 16)])
+    with pytest.raises(ValueError, match="sequence node"):
+        create_layer("attention").infer_shapes([(2, 3, 8, 16)])
+
+
+def test_layernorm_math():
+    ln = create_layer("layernorm")
+    ln.infer_shapes([(2, 1, 4, 8)])
+    params = ln.init_params(jax.random.PRNGKey(0), [(2, 1, 4, 8)])
+    x = np.random.RandomState(0).randn(2, 1, 4, 8).astype(np.float32)
+    (y,) = ln.apply(params, [x], train=True)
+    y = np.asarray(y)
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-3)
+
+
+@pytest.mark.parametrize("seq_parallel", ["ring", "ulysses"])
+def test_seq_parallel_equals_single_device(seq_parallel):
+    base = _make("")          # single device, blockwise path
+    seqp = _make("data:2,seq:2", seq_parallel)
+    assert seqp.mesh.shape.get("seq") == 2
+    for b in _batches():
+        base.update(b)
+        seqp.update(b)
+    wa, wb = _weights(base), _weights(seqp)
+    flat_a = jax.tree.leaves(wa)
+    flat_b = jax.tree.leaves(wb)
+    assert flat_a and len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(b, a, rtol=2e-4, atol=2e-5)
+
+
+def test_seq_sharded_input_placement():
+    t = _make("data:2,seq:2")
+    assert "seq" in str(t._data_sharded.spec)
+    t.update(_batches(1)[0])
+    # eval path shares the sharded-input route
+    pred = t.predict(_batches(1, 8)[0])
+    assert pred.shape == (8,)
+
+
+def test_training_reduces_loss():
+    """The block actually learns: a linearly-separable-ish synthetic
+    task's training error drops under the reference loop."""
+    t = _make("")
+    rng = np.random.RandomState(3)
+    # class k gets a +k bias on feature k: easily separable
+    data = rng.randn(64, 1, 8, 16).astype(np.float32)
+    label = rng.randint(0, 4, size=(64, 1)).astype(np.float32)
+    for i in range(64):
+        data[i, 0, :, int(label[i, 0])] += 2.0
+    batches = [DataBatch(data=data[i:i + 8], label=label[i:i + 8])
+               for i in range(0, 64, 8)]
+    errs = []
+    for _ in range(8):
+        for b in batches:
+            t.update(b)
+    preds = np.concatenate([t.predict(b) for b in batches])
+    err = float((preds != label[:, 0]).mean())
+    errs.append(err)
+    assert err < 0.3, f"transformer failed to learn: err={err}"
