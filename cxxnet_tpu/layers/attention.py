@@ -97,7 +97,9 @@ class AttentionLayer(Layer):
     def _core(self, q, k, v):
         """Route the attention core by the active mesh (same pattern as
         the Pallas LRN route, ops/nn.py): ring/ulysses under a 'seq'
-        axis, blockwise otherwise."""
+        axis; otherwise the fused Pallas flash kernel on TPU, blockwise
+        XLA elsewhere."""
+        from cxxnet_tpu.ops import pallas_attention as PA
         from cxxnet_tpu.parallel import ring as R
         from cxxnet_tpu.parallel.mesh import get_active_mesh
         mesh = get_active_mesh()
@@ -108,6 +110,12 @@ class AttentionLayer(Layer):
                 return R.ulysses_attention(q, k, v, mesh, causal=causal,
                                            kv_block=self.kv_block)
             return R.ring_attention(q, k, v, mesh, causal=causal)
+        if mesh is not None and mesh.devices.size > 1 \
+                and PA.use_flash_sharded(q, mesh):
+            return PA.flash_attention_sharded(q, k, v, mesh, causal)
+        if PA.use_flash(q):
+            return PA.flash_attention(q, k, v, causal, None,
+                                      PA._FORCE_INTERPRET)
         return ops_attn.blockwise_attention(q, k, v, causal=causal,
                                             kv_block=self.kv_block)
 
