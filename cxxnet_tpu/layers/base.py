@@ -156,11 +156,26 @@ class Layer:
         'model' mesh axis (parallel/sharding.py). {} = replicate all."""
         return {}
 
+    def expert_shard_dims(self) -> Dict[str, int]:
+        """Expert-parallel rule: param name -> dim sharded over the
+        'expert' mesh axis (layers/moe.py). {} = replicate all."""
+        return {}
+
     # --- compute ---------------------------------------------------------
     def apply(self, params: Params, inputs: List[jax.Array], *,
               train: bool, rng: Optional[jax.Array] = None,
               ) -> List[jax.Array]:
         raise NotImplementedError
+
+    #: layers contributing an auxiliary loss term (e.g. MoE load
+    #: balancing) set this True and implement
+    #:   apply_with_aux(params, inputs, *, train, rng=None, mask=None)
+    #:     -> (outputs, aux_scalar)
+    #: Network.forward adds aux_scalar into the same total the loss
+    #: layers accumulate (scaled 1/(batch*update_period) by the
+    #: trainer); `mask` is the (b,) padded-batch validity mask and must
+    #: exclude padding rows from any statistics the aux term uses.
+    has_aux: bool = False
 
     # --- checkpoint helpers ----------------------------------------------
     def check_one_to_one(self, in_shapes: List[Shape]) -> None:

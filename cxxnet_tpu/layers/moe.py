@@ -1,0 +1,152 @@
+"""Mixture-of-experts FFN layer with expert parallelism.
+
+Pure TPU-native extension (the reference predates MoE entirely): a
+switch-style top-k routed FFN over (batch, 1, seq, embed) sequence
+nodes, designed for GSPMD expert parallelism rather than hand-written
+all-to-all dispatch:
+
+- every expert's FFN weights live in stacked tensors with a leading
+  expert dim (w1 (E, H, e), w2 (E, e, H)); `expert_shard_dims` shards
+  that dim over an 'expert' mesh axis the same way `model_shard_dims`
+  drives tensor parallelism (parallel/sharding.py).
+- compute is the dense formulation: every expert runs on every token
+  and the router's top-k one-hot (scaled by the softmax prob, the
+  Switch-Transformer estimator) masks the sum. Under an expert-sharded
+  mesh each device computes only its local experts for all tokens and
+  one psum combines - the all-to-all-free EP layout. Per-device FLOPs
+  equal one dense FFN times E/n_expert_shards; there is no token
+  dropping and no capacity factor to tune.
+- the standard load-balance auxiliary loss (E * sum_e fraction_e *
+  mean_prob_e) is returned through the `apply_with_aux` protocol
+  (nnet/network.py adds it into total_loss; `moe_aux` scales it, 0
+  disables).
+
+Config keys: nexpert, nhidden (per-expert FFN hidden), moe_top_k
+(default 1), moe_aux (default 0.01), no_bias.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.layers.base import Layer, Params, Shape, register_layer
+
+
+@register_layer
+class MoELayer(Layer):
+    """moe: top-k routed mixture-of-experts FFN on sequence nodes."""
+
+    type_name = "moe"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.nexpert = 0
+        self.top_k = 1
+        self.aux_scale = 0.01
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "nexpert":
+            self.nexpert = int(val)
+        if name == "moe_top_k":
+            self.top_k = int(val)
+        if name == "moe_aux":
+            self.aux_scale = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
+        self.check_one_to_one(in_shapes)
+        b, c, s, e = in_shapes[0]
+        if c != 1:
+            raise ValueError("moe: input must be a sequence node")
+        if self.nexpert < 2:
+            raise ValueError("moe: must set nexpert >= 2")
+        if self.param.num_hidden <= 0:
+            raise ValueError("moe: must set nhidden correctly")
+        if not (1 <= self.top_k <= self.nexpert):
+            raise ValueError("moe: moe_top_k out of range")
+        return [in_shapes[0]]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
+        e = in_shapes[0][3]
+        h, g = self.param.num_hidden, self.nexpert
+        kg, k1, k2 = jax.random.split(key, 3)
+        params = {
+            "gate": self.param.rand_init_weight(kg, (g, e), in_num=e,
+                                                out_num=g),
+            "w1": self.param.rand_init_weight(k1, (g, h, e), in_num=e,
+                                              out_num=h),
+            "w2": self.param.rand_init_weight(k2, (g, e, h), in_num=h,
+                                              out_num=e),
+        }
+        if self.param.no_bias == 0:
+            params["b1"] = jnp.zeros((g, h), jnp.float32)
+            params["b2"] = jnp.zeros((g, e), jnp.float32)
+        return params
+
+    def param_tags(self) -> Dict[str, str]:
+        return {"gate": "wmat", "w1": "wmat", "w2": "wmat",
+                "b1": "bias", "b2": "bias"}
+
+    def expert_shard_dims(self) -> Dict[str, int]:
+        # the gate stays replicated: its (E, e) matrix is tiny and its
+        # logits are needed for every token on every expert shard
+        return {"w1": 0, "w2": 0, "b1": 0, "b2": 0}
+
+    def _route(self, probs, mask=None):
+        """(b, s, E) probs -> (combine (b, s, E), aux scalar).
+
+        `mask` is the (b,) padded-batch validity mask: padding rows
+        must not skew the load-balance statistics (their task loss is
+        masked the same way - nnet/network.py)."""
+        topv, topi = jax.lax.top_k(probs, self.top_k)
+        onehot = jax.nn.one_hot(topi, self.nexpert,
+                                dtype=probs.dtype)  # (b, s, k, E)
+        combine = jnp.sum(onehot * topv[..., None], axis=2)
+        # load-balance loss (Switch Transformer eq. 4): fraction of
+        # tokens routed to e (top-1 assignment) x mean router prob
+        top1 = jnp.sum(onehot[:, :, :1], axis=2)     # (b, s, E)
+        if mask is not None:
+            w = mask.astype(probs.dtype)[:, None, None]  # (b, 1, 1)
+            total = jnp.maximum(jnp.sum(w) * probs.shape[1], 1.0)
+            frac = jnp.sum(top1 * w, axis=(0, 1)) / total
+            mean_p = jnp.sum(probs * w, axis=(0, 1)) / total
+        else:
+            frac = jnp.mean(top1, axis=(0, 1))
+            mean_p = jnp.mean(probs, axis=(0, 1))
+        aux = self.nexpert * jnp.sum(frac * mean_p)
+        return combine, aux
+
+    has_aux = True
+
+    def apply_with_aux(self, params, inputs, *, train, rng=None,
+                       mask=None) -> Tuple[List[jax.Array], jax.Array]:
+        x = inputs[0]
+        b, _, s, e = x.shape
+        xs = x.reshape(b, s, e)
+        logits = jnp.einsum("bse,ge->bsg", xs,
+                            params["gate"].astype(x.dtype))
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        combine, aux = self._route(probs, mask)
+        # dense expert compute; the expert dim g rides the 'expert'
+        # mesh axis, so each device computes its local experts only
+        h1 = jnp.einsum("bse,ghe->bsgh", xs, params["w1"].astype(x.dtype))
+        if "b1" in params:
+            h1 = h1 + params["b1"].astype(x.dtype)[None, None]
+        h1 = jnp.maximum(h1, 0.0)
+        ye = jnp.einsum("bsgh,geh->bsge", h1,
+                        params["w2"].astype(x.dtype))
+        if "b2" in params:
+            ye = ye + params["b2"].astype(x.dtype)[None, None]
+        out = jnp.einsum("bsge,bsg->bse", ye, combine.astype(x.dtype))
+        # scaled by batch so the trainer's 1/(batch*update_period)
+        # normalization leaves the aux term batch-size-invariant
+        aux_term = (self.aux_scale * b) * aux if self.aux_scale else \
+            jnp.zeros((), jnp.float32)
+        return [out.reshape(b, 1, s, e)], aux_term.astype(jnp.float32)
+
+    def apply(self, params, inputs, *, train, rng=None):
+        outs, _ = self.apply_with_aux(params, inputs, train=train, rng=rng)
+        return outs

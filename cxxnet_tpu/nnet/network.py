@@ -171,7 +171,17 @@ class Network:
                 values[info.nindex_out[0]] = out
                 continue
 
-            outs = layer.apply(p, xs, train=train, rng=layer_rng)
+            if layer.has_aux:
+                # layers with an auxiliary loss term (e.g. the MoE
+                # load-balance loss, layers/moe.py) fold it into the
+                # same total the loss layers accumulate (contract on
+                # Layer.has_aux, layers/base.py)
+                outs, aux = layer.apply_with_aux(p, xs, train=train,
+                                                 rng=layer_rng, mask=mask)
+                if train:
+                    total_loss = total_loss + aux
+            else:
+                outs = layer.apply(p, xs, train=train, rng=layer_rng)
             for j, o in zip(info.nindex_out, outs):
                 values[j] = o
 

@@ -100,10 +100,11 @@ def _ring_jit(q, k, v, mesh, causal, scale):
             return (k_nxt, v_nxt, part), None
 
         # the empty partial is built from constants; mark it as varying
-        # over the mesh axes so the scan carry types stay consistent
-        # (jax >= 0.7 vma typing; no-op on older jax)
+        # over exactly the axes the inputs vary on (the in_specs' axes -
+        # NOT every mesh axis: an unmentioned axis, e.g. 'expert', must
+        # stay replicated or the out_specs vma check rejects the body)
         part0 = empty_partial(q)
-        axes = tuple(mesh.axis_names)
+        axes = tuple(a for a in spec if a is not None)
         if hasattr(lax, "pcast"):
             part0 = jax.tree.map(
                 lambda x: lax.pcast(x, axes, to="varying"), part0)

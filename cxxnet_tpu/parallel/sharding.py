@@ -29,12 +29,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cxxnet_tpu.nnet.network import Network, param_key
 
 MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
 DATA_AXIS = "data"
 
 
 def param_pspecs(net: Network, shapes=None) -> Dict[str, Dict[str, P]]:
     """PartitionSpec per parameter; P() (replicated) unless the layer
-    declares a model-shard dim and the dim divides the axis size."""
+    declares a model- and/or expert-shard dim. A param may ride both
+    axes on different dims (none of the shipped layers do, but the
+    combination is legal GSPMD)."""
     if shapes is None:
         shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
     specs: Dict[str, Dict[str, P]] = {}
@@ -44,16 +47,17 @@ def param_pspecs(net: Network, shapes=None) -> Dict[str, Dict[str, P]]:
         lk = param_key(net.cfg, idx)
         if lk not in shapes:
             continue
-        dims = net.layer_objs[idx].model_shard_dims()
+        layer = net.layer_objs[idx]
+        by_axis = ((MODEL_AXIS, layer.model_shard_dims()),
+                   (EXPERT_AXIS, layer.expert_shard_dims()))
         specs[lk] = {}
         for pn, sd in shapes[lk].items():
-            d = dims.get(pn)
-            if d is None:
-                specs[lk][pn] = P()
-            else:
-                spec = [None] * len(sd.shape)
-                spec[d] = MODEL_AXIS
-                specs[lk][pn] = P(*spec)
+            spec = [None] * len(sd.shape)
+            for axis, dims in by_axis:
+                d = dims.get(pn)
+                if d is not None and spec[d] is None:
+                    spec[d] = axis
+            specs[lk][pn] = P(*spec) if any(spec) else P()
     return specs
 
 
@@ -99,24 +103,23 @@ def shardings_for(mesh: Mesh,
                   net: Network) -> Dict[str, Dict[str, NamedSharding]]:
     """NamedSharding tree parallel to the params pytree (two levels).
 
-    Falls back to replication when 'model' is absent from the mesh or the
-    sharded dim does not divide the axis size.
+    Each declared axis ('model', 'expert') is dropped back to
+    replication independently when it is absent from the mesh, has size
+    1, or the sharded dim does not divide its size.
     """
-    have_model = MODEL_AXIS in mesh.axis_names
-    msize = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-        MODEL_AXIS, 1)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
     pspecs = param_pspecs(net, shapes)
     out: Dict[str, Dict[str, NamedSharding]] = {}
     for lk, d in pspecs.items():
         out[lk] = {}
         for pn, spec in d.items():
-            if (not have_model or msize == 1 or spec == P()):
-                out[lk][pn] = NamedSharding(mesh, P())
-                continue
-            dim = next(i for i, a in enumerate(spec) if a == MODEL_AXIS)
-            if shapes[lk][pn].shape[dim] % msize != 0:
-                out[lk][pn] = NamedSharding(mesh, P())
-            else:
-                out[lk][pn] = NamedSharding(mesh, spec)
+            kept = []
+            for i, ax in enumerate(tuple(spec)):
+                n = sizes.get(ax, 1) if ax is not None else 1
+                ok = (ax is not None and n > 1
+                      and shapes[lk][pn].shape[i] % n == 0)
+                kept.append(ax if ok else None)
+            out[lk][pn] = NamedSharding(
+                mesh, P(*kept) if any(kept) else P())
     return out

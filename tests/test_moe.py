@@ -1,0 +1,184 @@
+"""MoE layer: routing math, aux loss, expert-parallel sharding, and the
+EP == single-device training invariant."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.layers import create_layer
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+MOE_NET = """
+netconfig=start
+layer[0->1] = layernorm:ln1
+layer[1->2] = moe:moe1
+  nexpert = 4
+  nhidden = 16
+  moe_top_k = 2
+  init_sigma = 0.1
+layer[2->3] = flatten
+layer[3->4] = fullc:head
+  nhidden = 4
+layer[4->4] = softmax
+netconfig=end
+input_shape = 1,4,8
+random_type = gaussian
+init_sigma = 0.1
+eta = 0.05
+momentum = 0.9
+batch_size = 8
+silent = 1
+eval_train = 0
+"""
+
+
+def _make(mesh: str) -> NetTrainer:
+    t = NetTrainer()
+    for k, v in parse_config_string(MOE_NET):
+        t.set_param(k, v)
+    if mesh:
+        t.set_param("mesh", mesh)
+    t.init_model()
+    return t
+
+
+def _batches(n=3, b=8):
+    rng = np.random.RandomState(5)
+    return [DataBatch(
+        data=rng.randn(b, 1, 4, 8).astype(np.float32),
+        label=rng.randint(0, 4, size=(b, 1)).astype(np.float32))
+        for _ in range(n)]
+
+
+def _layer(**kw):
+    m = create_layer("moe")
+    m.set_param("nexpert", str(kw.get("nexpert", 4)))
+    m.set_param("nhidden", str(kw.get("nhidden", 8)))
+    m.set_param("moe_top_k", str(kw.get("top_k", 1)))
+    return m
+
+
+def test_shape_and_validation():
+    m = _layer()
+    assert m.infer_shapes([(2, 1, 4, 8)]) == [(2, 1, 4, 8)]
+    with pytest.raises(ValueError, match="nexpert"):
+        _layer(nexpert=1).infer_shapes([(2, 1, 4, 8)])
+    with pytest.raises(ValueError, match="sequence node"):
+        _layer().infer_shapes([(2, 3, 4, 8)])
+    with pytest.raises(ValueError, match="top_k"):
+        _layer(top_k=9).infer_shapes([(2, 1, 4, 8)])
+
+
+def test_full_topk_equals_dense_mixture():
+    """top_k == nexpert makes the routed sum the full softmax mixture -
+    an analytically checkable reference."""
+    m = _layer(nexpert=3, nhidden=8, top_k=3)
+    m.infer_shapes([(2, 1, 4, 8)])
+    params = m.init_params(jax.random.PRNGKey(0), [(2, 1, 4, 8)])
+    x = np.random.RandomState(0).randn(2, 1, 4, 8).astype(np.float32)
+    (y,), _ = m.apply_with_aux(params, [x], train=True)
+
+    xs = x.reshape(2, 4, 8)
+    logits = np.einsum("bse,ge->bsg", xs, np.asarray(params["gate"]))
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    h1 = np.maximum(
+        np.einsum("bse,ghe->bsgh", xs, np.asarray(params["w1"]))
+        + np.asarray(params["b1"])[None, None], 0.0)
+    ye = (np.einsum("bsgh,geh->bsge", h1, np.asarray(params["w2"]))
+          + np.asarray(params["b2"])[None, None])
+    ref = np.einsum("bsge,bsg->bse", ye, probs).reshape(2, 1, 4, 8)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_top1_uses_single_expert():
+    """With top_k=1, the output equals the argmax expert's FFN scaled by
+    its router prob, token by token."""
+    m = _layer(nexpert=4, nhidden=8, top_k=1)
+    m.infer_shapes([(1, 1, 4, 8)])
+    params = m.init_params(jax.random.PRNGKey(1), [(1, 1, 4, 8)])
+    x = np.random.RandomState(1).randn(1, 1, 4, 8).astype(np.float32)
+    (y,), _ = m.apply_with_aux(params, [x], train=True)
+    xs = x.reshape(4, 8)
+    logits = xs @ np.asarray(params["gate"]).T
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    for t in range(4):
+        g = int(np.argmax(logits[t]))
+        h1 = np.maximum(np.asarray(params["w1"])[g] @ xs[t]
+                        + np.asarray(params["b1"])[g], 0)
+        ref = (np.asarray(params["w2"])[g] @ h1
+               + np.asarray(params["b2"])[g]) * probs[t, g]
+        np.testing.assert_allclose(np.asarray(y)[0, 0, t], ref,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_aux_loss_balanced_is_one():
+    """Zero gate weights -> uniform router: the Switch load-balance
+    term is exactly 1 (times moe_aux times batch)."""
+    m = _layer(nexpert=4, nhidden=8, top_k=1)
+    m.set_param("moe_aux", "0.5")
+    m.infer_shapes([(2, 1, 4, 8)])
+    params = m.init_params(jax.random.PRNGKey(0), [(2, 1, 4, 8)])
+    params["gate"] = jnp.zeros_like(params["gate"])
+    x = np.random.RandomState(2).randn(2, 1, 4, 8).astype(np.float32)
+    _, aux = m.apply_with_aux(params, [x], train=True)
+    np.testing.assert_allclose(float(aux), 0.5 * 2 * 1.0, rtol=1e-5)
+
+
+def test_aux_loss_ignores_padding_rows():
+    """A padded batch's aux term (with the validity mask) must equal
+    the unpadded batch's aux term, scaled for the batch-dim change -
+    padding rows carry no router statistics."""
+    m = _layer(nexpert=4, nhidden=8, top_k=1)
+    m.set_param("moe_aux", "1.0")
+    m.infer_shapes([(4, 1, 4, 8)])
+    params = m.init_params(jax.random.PRNGKey(3), [(4, 1, 4, 8)])
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 1, 4, 8).astype(np.float32)
+    _, aux_ref = m.apply_with_aux(params, [x], train=True)
+    xpad = np.concatenate([x, np.zeros((2, 1, 4, 8), np.float32)])
+    mask = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    _, aux_pad = m.apply_with_aux(params, [xpad], train=True, mask=mask)
+    # aux_term scales by the (padded) batch dim: 4 vs 2
+    np.testing.assert_allclose(float(aux_pad) / 4.0,
+                               float(aux_ref) / 2.0, rtol=1e-5)
+
+
+def test_expert_parallel_equals_single_device():
+    base = _make("")
+    ep = _make("data:2,expert:2")
+    # the stacked expert weights really ride the 'expert' axis
+    assert ep._pshard["moe1"]["w1"].spec[0] == "expert"
+    assert ep._pshard["moe1"]["gate"].spec == ()  # replicated
+    for b in _batches():
+        base.update(b)
+        ep.update(b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(base.state["params"])),
+                    jax.tree.leaves(jax.device_get(ep.state["params"]))):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_indivisible_expert_axis_replicates():
+    t = _make("data:2,expert:3")  # 4 experts % 3 != 0
+    assert t._pshard["moe1"]["w1"].spec == ()
+
+
+def test_moe_training_learns():
+    t = _make("")
+    rng = np.random.RandomState(9)
+    data = rng.randn(64, 1, 4, 8).astype(np.float32)
+    label = rng.randint(0, 4, size=(64, 1)).astype(np.float32)
+    for i in range(64):
+        data[i, 0, :, int(label[i, 0])] += 2.5
+    batches = [DataBatch(data=data[i:i + 8], label=label[i:i + 8])
+               for i in range(0, 64, 8)]
+    for _ in range(10):
+        for b in batches:
+            t.update(b)
+    preds = np.concatenate([t.predict(b) for b in batches])
+    err = float((preds != label[:, 0]).mean())
+    assert err < 0.3, f"moe net failed to learn: err={err}"
