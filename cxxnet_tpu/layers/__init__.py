@@ -8,6 +8,7 @@ from cxxnet_tpu.layers import attention as _attention  # noqa: F401
 from cxxnet_tpu.layers import common as _common  # noqa: F401
 from cxxnet_tpu.layers import loss as _loss  # noqa: F401
 from cxxnet_tpu.layers import moe as _moe  # noqa: F401
+from cxxnet_tpu.layers import transformer_stack as _tstack  # noqa: F401
 from cxxnet_tpu.layers import pairtest as _pairtest  # noqa: F401
 from cxxnet_tpu.layers.loss import LossLayer
 

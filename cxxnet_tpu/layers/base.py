@@ -161,6 +161,12 @@ class Layer:
         'expert' mesh axis (layers/moe.py). {} = replicate all."""
         return {}
 
+    def pipe_shard_dims(self) -> Dict[str, int]:
+        """Pipeline-parallel rule: param name -> dim sharded over the
+        'pipe' mesh axis (layers/transformer_stack.py). {} = replicate
+        all."""
+        return {}
+
     # --- compute ---------------------------------------------------------
     def apply(self, params: Params, inputs: List[jax.Array], *,
               train: bool, rng: Optional[jax.Array] = None,

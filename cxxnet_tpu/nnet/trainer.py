@@ -261,16 +261,20 @@ class NetTrainer:
 
     @property
     def _batch_sharded(self):
-        return NamedSharding(self.mesh, P("data"))
+        # a mesh without a 'data' axis (e.g. pure pipeline parallelism,
+        # mesh=pipe:4) replicates the batch
+        d = "data" if "data" in self.mesh.axis_names else None
+        return NamedSharding(self.mesh, P(d) if d else P())
 
     @property
     def _data_sharded(self):
         """Input-tensor sharding: batch over 'data' and, for sequence
         models on a mesh with a 'seq' axis, the sequence (y) dim over
         'seq' (parallel/ring.py). Labels/mask stay batch-only."""
+        d = "data" if "data" in self.mesh.axis_names else None
         nseq = self.mesh.shape.get("seq", 1)
         if nseq > 1 and self.net_cfg.input_shape[1] % nseq == 0:
-            return NamedSharding(self.mesh, P("data", None, "seq", None))
+            return NamedSharding(self.mesh, P(d, None, "seq", None))
         return self._batch_sharded
 
     def _label_fields(self, label: np.ndarray) -> Dict[str, np.ndarray]:

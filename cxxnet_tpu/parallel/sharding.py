@@ -30,6 +30,7 @@ from cxxnet_tpu.nnet.network import Network, param_key
 
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
 
 
@@ -49,7 +50,8 @@ def param_pspecs(net: Network, shapes=None) -> Dict[str, Dict[str, P]]:
             continue
         layer = net.layer_objs[idx]
         by_axis = ((MODEL_AXIS, layer.model_shard_dims()),
-                   (EXPERT_AXIS, layer.expert_shard_dims()))
+                   (EXPERT_AXIS, layer.expert_shard_dims()),
+                   (PIPE_AXIS, layer.pipe_shard_dims()))
         specs[lk] = {}
         for pn, sd in shapes[lk].items():
             spec = [None] * len(sd.shape)
