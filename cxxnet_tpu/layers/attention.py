@@ -32,6 +32,33 @@ from cxxnet_tpu.layers.base import Layer, Params, Shape, register_layer
 from cxxnet_tpu.ops import attention as ops_attn
 
 
+def layer_norm(x, slope, bias, eps):
+    """Normalize the last dim in f32; shared by the layernorm layer and
+    transformer_stack's in-block norms."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * slope + bias).astype(x.dtype)
+
+
+def qkv_heads(xs, wqkv, bqkv, nhead):
+    """(b, s, e) x (3e, e) [+ (3e,)] -> q, k, v as (b, h, s, e/h)."""
+    b, s, e = xs.shape
+    qkv = jnp.einsum("bse,fe->bsf", xs, wqkv.astype(xs.dtype))
+    if bqkv is not None:
+        qkv = qkv + bqkv.astype(xs.dtype)[None, None, :]
+    qkv = qkv.reshape(b, s, 3, nhead, e // nhead)
+    return tuple(jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+
+
+def heads_proj(o, wproj):
+    """(b, h, s, d) heads -> (b, s, e) through the output projection."""
+    b, h, s, d = o.shape
+    o = jnp.moveaxis(o, 1, 2).reshape(b, s, h * d)
+    return jnp.einsum("bsf,ef->bse", o, wproj.astype(o.dtype))
+
+
 @register_layer
 class AttentionLayer(Layer):
     """Multi-head self-attention on (b, 1, s, e) sequence nodes."""
@@ -122,17 +149,9 @@ class AttentionLayer(Layer):
     def apply(self, params, inputs, *, train, rng=None):
         x = inputs[0]
         b, _, s, e = x.shape
-        h = self.nhead
-        xs = x.reshape(b, s, e)
-        qkv = jnp.einsum("bse,fe->bsf", xs, params["wmat"])
-        if "bias" in params:
-            qkv = qkv + params["bias"][None, None, :]
-        # (b, s, 3e) -> 3 x (b, h, s, e/h)
-        qkv = qkv.reshape(b, s, 3, h, e // h)
-        q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
-        o = self._core(q, k, v)
-        o = jnp.moveaxis(o, 1, 2).reshape(b, s, e)
-        out = jnp.einsum("bsf,ef->bse", o, params["wproj"])
+        q, k, v = qkv_heads(x.reshape(b, s, e), params["wmat"],
+                            params.get("bias"), self.nhead)
+        out = heads_proj(self._core(q, k, v), params["wproj"])
         return [out.reshape(b, 1, s, e)]
 
 
@@ -214,13 +233,8 @@ class LayerNormLayer(Layer):
         return {"slope": "wmat", "bias": "bias"}
 
     def apply(self, params, inputs, *, train, rng=None):
-        x = inputs[0]
-        xf = x.astype(jnp.float32)
-        mu = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.var(xf, axis=-1, keepdims=True)
-        y = (xf - mu) * jax.lax.rsqrt(var + self.eps)
-        y = y * params["slope"] + params["bias"]
-        return [y.astype(x.dtype)]
+        return [layer_norm(inputs[0], params["slope"], params["bias"],
+                           self.eps)]
 
 
 @register_layer

@@ -16,7 +16,9 @@ things the per-layer config DAG cannot express:
 - with `mesh = ...,pipe:P` (L % P == 0): GPipe pipeline parallelism as
   one shard_map program. Device p holds only its L/P stage params
   (pipe_shard_dims -> HBM scales 1/P); the per-data-shard batch splits
-  into M microbatches (config `microbatch`, default P) that flow
+  into M microbatches (config `microbatch`; an explicit value that
+  does not divide the per-shard batch is an error, and the default
+  picks the largest divisor <= P) that flow
   through the stages via lax.ppermute, M + P - 1 schedule ticks with
   the standard GPipe bubble (P-1)/(M+P-1). Autodiff through the
   schedule IS the reverse pipeline (ppermute transposes to the
@@ -124,27 +126,18 @@ class TransformerStackLayer(Layer):
                                  "w2", "b2")}
 
     # ------------------------------------------------------------------
-    def _ln(self, x, slope, bias):
-        xf = x.astype(jnp.float32)
-        mu = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.var(xf, axis=-1, keepdims=True)
-        return ((xf - mu) * lax.rsqrt(var + self.eps) * slope
-                + bias).astype(x.dtype)
-
     def _block(self, bp, x):
-        """One block; bp leaves have NO leading layer dim; x (b, s, e)."""
-        b, s, e = x.shape
-        hd = e // self.nhead
-        h = self._ln(x, bp["ln1_s"], bp["ln1_b"])
-        qkv = jnp.einsum("bse,fe->bsf", h, bp["wqkv"].astype(x.dtype))
-        qkv = qkv + bp["bqkv"].astype(x.dtype)[None, None]
-        qkv = qkv.reshape(b, s, 3, self.nhead, hd)
-        q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+        """One block; bp leaves have NO leading layer dim; x (b, s, e).
+        Norm + QKV plumbing shared with the single-layer family
+        (layers/attention.py helpers)."""
+        from cxxnet_tpu.layers.attention import (
+            heads_proj, layer_norm, qkv_heads)
+        h = layer_norm(x, bp["ln1_s"], bp["ln1_b"], self.eps)
+        q, k, v = qkv_heads(h, bp["wqkv"], bp["bqkv"], self.nhead)
         o = blockwise_attention(q, k, v, causal=bool(self.causal),
                                 kv_block=self.kv_block)
-        o = jnp.moveaxis(o, 1, 2).reshape(b, s, e)
-        x = x + jnp.einsum("bsf,ef->bse", o, bp["wproj"].astype(x.dtype))
-        h2 = self._ln(x, bp["ln2_s"], bp["ln2_b"])
+        x = x + heads_proj(o, bp["wproj"])
+        h2 = layer_norm(x, bp["ln2_s"], bp["ln2_b"], self.eps)
         f = jnp.einsum("bse,he->bsh", h2, bp["w1"].astype(x.dtype))
         f = jnp.maximum(f + bp["b1"].astype(x.dtype)[None, None], 0.0)
         f = jnp.einsum("bsh,eh->bse", f, bp["w2"].astype(x.dtype))
@@ -170,13 +163,26 @@ class TransformerStackLayer(Layer):
 
     def _pipelined(self, params, x, mesh, P):
         """GPipe schedule as one shard_map program; x (b, s, e) global."""
-        M = self.microbatch or P
         names = mesh.axis_names
         data = "data" if "data" in names else None
         dsize = mesh.shape.get("data", 1) if data else 1
         b = x.shape[0]
-        if b % dsize != 0 or (b // dsize) % M != 0:
-            return self._scan_blocks(params, x)  # indivisible microbatch
+        b_local = b // dsize
+        if self.microbatch:
+            # an explicit microbatch that cannot divide the per-shard
+            # batch must fail loudly, not silently de-pipeline
+            if b % dsize != 0 or b_local % self.microbatch != 0:
+                raise ValueError(
+                    f"transformer_stack: microbatch={self.microbatch} "
+                    f"does not divide the per-data-shard batch "
+                    f"{b_local} (batch {b} over data:{dsize})")
+            M = self.microbatch
+        else:
+            # default: as close to P microbatches as divides the
+            # per-shard batch (M=1 still pipelines - full bubble, but
+            # stage params stay sharded 1/P)
+            M = next(m for m in range(min(P, b_local), 0, -1)
+                     if b_local % m == 0)
         xspec = jax.sharding.PartitionSpec(data, None, None)
         pspec = jax.tree.map(
             lambda _: jax.sharding.PartitionSpec(PIPE_AXIS), params)

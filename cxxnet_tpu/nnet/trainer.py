@@ -128,6 +128,19 @@ class NetTrainer:
         if name == "dtype":
             self.compute_dtype = {"float32": jnp.float32,
                                   "bfloat16": jnp.bfloat16}[val]
+        if name == "compile_cache" and val:
+            # persistent XLA compilation cache: the first AlexNet-sized
+            # TPU compile costs 20-40 s; with this set, re-runs (resume,
+            # pred, eval-only) hit the on-disk cache instead. No
+            # reference analog (CUDA kernels are precompiled; XLA's
+            # compile-at-trace model creates the need). NOTE: the cache
+            # is PROCESS-GLOBAL jax state (one cache per process, last
+            # writer wins) - not per-trainer.
+            jax.config.update("jax_compilation_cache_dir", val)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0)
         if name.startswith("metric"):
             import re
             m = re.match(r"^metric\[([^,\]]+),([^\]]+)\]$", name)
