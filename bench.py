@@ -123,6 +123,55 @@ def _measure_e2e(trainer, batch, steps, profile_dir=""):
     return steps * batch / dt
 
 
+def _bench_attention(platform: str) -> dict:
+    """Flash-attention kernel micro-bench (TPU only): fwd+bwd TFLOP/s
+    for the Pallas kernel vs the XLA blockwise path on a transformer
+    shape (b4 h8 s4096 d128, bf16). This is the kernel's on-hardware
+    validation - the sandbox's CPU mesh can only run it in interpret
+    mode - so a kernel failure degrades to an error field, never kills
+    the headline bench. Disable with CXN_BENCH_ATTN=0."""
+    if platform != "tpu" or os.environ.get("CXN_BENCH_ATTN") == "0":
+        return {}
+    try:
+        import jax
+        import jax.numpy as jnp
+        from cxxnet_tpu.ops.attention import blockwise_attention
+        from cxxnet_tpu.ops.pallas_attention import flash_attention
+
+        b, h, s, d = 4, 8, 4096, 128
+        rng = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+                   for _ in range(3))
+        # fwd 2 matmuls (4bhs^2d flops) + bwd 5 matmuls (10bhs^2d)
+        flops = 14.0 * b * h * s * s * d
+        steps = 10
+
+        def measure(core):
+            # all three grads: argnums=0 alone would let XLA dead-code
+            # the dK/dV matmuls out of the XLA path while the fused
+            # Pallas bwd computes them regardless, skewing the ratio
+            f = jax.jit(jax.grad(
+                lambda q, k, v: core(q, k, v).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2)))
+            g = f(q, k, v)
+            jax.block_until_ready(g)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                g = f(q, k, v)
+            jax.block_until_ready(g)
+            return steps * flops / (time.perf_counter() - t0) / 1e12
+
+        pallas_tf = measure(
+            lambda q, k, v: flash_attention(q, k, v, False, None, False))
+        xla_tf = measure(
+            lambda q, k, v: blockwise_attention(q, k, v, kv_block=512))
+        return {"attn_pallas_tflops": round(pallas_tf, 2),
+                "attn_xla_tflops": round(xla_tf, 2),
+                "attn_pallas_speedup": round(pallas_tf / xla_tf, 3)}
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"attn_error": f"{type(e).__name__}: {e}"}
+
+
 def run(profile_dir="", steps_override=0) -> dict:
     import jax
     from __graft_entry__ import _ALEXNET_CONF, _make_trainer
@@ -182,6 +231,7 @@ def run(profile_dir="", steps_override=0) -> dict:
         "per_device_batch": batch // ndev,
         "steps": steps,
     }
+    out.update(_bench_attention(platform))
     if os.environ.get("CXN_BENCH_FALLBACK") == "1":
         src = os.environ.get("CXN_BENCH_FALLBACK_FROM", "default")
         out["fallback"] = (f"backend '{src}' hung; CPU harness run")

@@ -43,7 +43,13 @@ def layer_norm(x, slope, bias, eps):
 
 
 def qkv_heads(xs, wqkv, bqkv, nhead):
-    """(b, s, e) x (3e, e) [+ (3e,)] -> q, k, v as (b, h, s, e/h)."""
+    """(b, s, e) x (3e, e) [+ (3e,)] -> q, k, v as (b, h, s, e/h).
+
+    Weights are cast to the ACTIVATION dtype (the trainer pre-casts
+    params to the compute dtype anyway - trainer._cast - so in-product
+    this is a no-op; direct mixed-dtype callers get the bf16 MXU path
+    rather than a silent f32 promotion, same convention as moe /
+    transformer_stack)."""
     b, s, e = xs.shape
     qkv = jnp.einsum("bse,fe->bsf", xs, wqkv.astype(xs.dtype))
     if bqkv is not None:

@@ -41,6 +41,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cxxnet_tpu.layers.attention import (
+    heads_proj, layer_norm, qkv_heads)
 from cxxnet_tpu.layers.base import Layer, Params, Shape, register_layer
 from cxxnet_tpu.ops.attention import blockwise_attention
 
@@ -130,8 +132,6 @@ class TransformerStackLayer(Layer):
         """One block; bp leaves have NO leading layer dim; x (b, s, e).
         Norm + QKV plumbing shared with the single-layer family
         (layers/attention.py helpers)."""
-        from cxxnet_tpu.layers.attention import (
-            heads_proj, layer_norm, qkv_heads)
         h = layer_norm(x, bp["ln1_s"], bp["ln1_b"], self.eps)
         q, k, v = qkv_heads(h, bp["wqkv"], bp["bqkv"], self.nhead)
         o = blockwise_attention(q, k, v, causal=bool(self.causal),
@@ -178,6 +178,10 @@ class TransformerStackLayer(Layer):
                     f"{b_local} (batch {b} over data:{dsize})")
             M = self.microbatch
         else:
+            if b % dsize != 0 or b_local == 0:
+                # degenerate direct-layer use (the trainer's mesh
+                # builder enforces batch divisibility): sequential route
+                return self._scan_blocks(params, x)
             # default: as close to P microbatches as divides the
             # per-shard batch (M=1 still pipelines - full bubble, but
             # stage params stay sharded 1/P)
