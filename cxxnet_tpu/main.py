@@ -257,18 +257,24 @@ class LearnTask:
         def init_iter(it):
             for k, v in defcfg:
                 it.set_param(k, v)
-            # multi-controller: each worker feeds its local slice of the
-            # global batch from its own data shard (auto-wired unless the
-            # config sets dist_num_worker explicitly)
+            # multi-controller: each worker feeds the batch rows its
+            # devices OWN under the mesh (auto-wired unless the config
+            # sets dist_num_worker explicitly). Mesh-aware: on a pure
+            # data mesh that is batch/nproc rows from a per-worker data
+            # shard; on a mesh whose batch dim is replicated across
+            # processes (e.g. a cross-host 'seq' axis - the batch
+            # splits over the sequence dim instead), every worker must
+            # feed the SAME full batch, so no data shard is applied.
             import jax
             if jax.process_count() > 1:
-                it.set_param("batch_size", str(
-                    self.batch_size // jax.process_count()))
-                if not any(k == "dist_num_worker" for k, _ in self.cfg):
-                    it.set_param("dist_num_worker",
-                                 str(jax.process_count()))
-                    it.set_param("dist_worker_rank",
-                                 str(jax.process_index()))
+                lb = self.net_trainer._local_batch
+                it.set_param("batch_size", str(lb))
+                nshard = self.batch_size // lb
+                if nshard > 1 and not any(
+                        k == "dist_num_worker" for k, _ in self.cfg):
+                    shard = self.net_trainer._local_row_start // lb
+                    it.set_param("dist_num_worker", str(nshard))
+                    it.set_param("dist_worker_rank", str(shard))
             it.init()
 
         for it in filter(None, [self.itr_train, self.itr_pred]):

@@ -180,6 +180,7 @@ class NetTrainer:
                 print(f"node[{self.net_cfg.node_names[i]}].shape: "
                       f"{s[0]},{s[1]},{s[2]},{s[3]}")
         self.mesh = build_mesh(self.mesh_spec, self.batch_size)
+        self._local_rows = self._compute_local_rows()
         # tensor-parallel parameter shardings over the 'model' mesh axis
         # (all-replicated on a pure-data mesh - parallel/sharding.py)
         self._pshard = shardings_for(self.mesh, self.net)
@@ -495,12 +496,37 @@ class NetTrainer:
             return ""
         return self.profiler.summary()
 
+    def _compute_local_rows(self) -> Tuple[int, int]:
+        """(rows this process feeds, their global start row) under the
+        batch sharding - batch/nproc on a pure-data mesh, but the FULL
+        batch when the batch dim is replicated across processes (e.g. a
+        cross-host 'seq' mesh, where hosts split the sequence dim
+        instead - parallel/ring.py). Mesh-invariant after _build_net,
+        so computed once there (this sits on the per-step hot path)."""
+        if jax.process_count() == 1:
+            return self.batch_size, 0
+        shd = self._batch_sharded
+        imap = shd.devices_indices_map((self.batch_size,))
+        spans = {imap[d][0].indices(self.batch_size)[:2]
+                 for d in shd.addressable_devices}
+        return (sum(stop - start for start, stop in spans),
+                min(start for start, _ in spans))
+
     @property
     def _local_batch(self) -> int:
-        """Rows this process feeds (== batch_size when single-process;
-        batch_size/process_count under multi-controller SPMD, where the
-        per-worker iterators each carry their shard)."""
-        return distributed.local_batch_size(self.batch_size)
+        return self._local_rows[0]
+
+    @property
+    def _local_row_start(self) -> int:
+        return self._local_rows[1]
+
+    def _put_data(self, data: np.ndarray) -> jax.Array:
+        """Stage the input tensor under _data_sharded; correct even
+        when the 'seq' axis spans processes (put_global_rows)."""
+        gshape = (self.batch_size,) + data.shape[1:]
+        return distributed.put_global_rows(
+            self._host_input(data), self._data_sharded, gshape,
+            self._local_row_start)
 
     def _pad_batch(self, batch: DataBatch):
         """Pad a short batch up to the local batch (static shapes).
@@ -539,8 +565,7 @@ class NetTrainer:
         self._step_counter += 1
         labels = self._label_fields(label.astype(np.float32))
         shd = self._batch_sharded
-        gdata = distributed.put_global(self._host_input(data),
-                                       self._data_sharded)
+        gdata = self._put_data(data)
         glabels = {k: distributed.put_global(v, shd)
                    for k, v in labels.items()}
         gmask = distributed.put_global(mask.astype(np.float32), shd)
@@ -578,8 +603,7 @@ class NetTrainer:
     # ------------------------------------------------------------------
     def _forward_nodes(self, batch: DataBatch) -> Dict[int, np.ndarray]:
         data, _, mask = self._pad_batch(batch)
-        gdata = distributed.put_global(self._host_input(data),
-                                       self._data_sharded)
+        gdata = self._put_data(data)
         outs = self._eval_step(self.state["params"], gdata)
         valid = int(mask.sum())
         return {nid: distributed.fetch_local(v)[:valid]
@@ -607,8 +631,7 @@ class NetTrainer:
                 labels = self._label_fields(label.astype(np.float32))
                 per_batch.append(self._eval_metric_step(
                     self.state["params"],
-                    distributed.put_global(self._host_input(data),
-                                           self._data_sharded),
+                    self._put_data(data),
                     {k: distributed.put_global(v, shd)
                      for k, v in labels.items()},
                     distributed.put_global(mask.astype(np.float32), shd),

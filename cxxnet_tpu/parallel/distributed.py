@@ -87,42 +87,59 @@ def process_index() -> int:
     return jax.process_index()
 
 
-def local_batch_size(global_batch: int) -> int:
-    n = jax.process_count()
-    if global_batch % n != 0:
-        raise ValueError(
-            f"global batch_size {global_batch} must divide across "
-            f"{n} worker processes")
-    return global_batch // n
-
-
 # ---------------------------------------------------------------------------
 # global-array construction / host readback (multi-process safe)
 # ---------------------------------------------------------------------------
 
 def put_global(arr: np.ndarray, sharding) -> jax.Array:
-    """Host array -> global jax.Array under `sharding`.
+    """Host array -> global jax.Array under a BATCH-DIM-ONLY sharding
+    (labels, masks, replicated scalars).
 
     Single process: plain device_put. Multi-process: `arr` is this
-    process's LOCAL slice for batch-sharded inputs (the iterator already
-    shards per worker), or the full identical value for replicated ones;
-    make_array_from_process_local_data assembles the global view.
+    process's local batch rows (or the full identical value for
+    replicated leaves); make_array_from_process_local_data assembles
+    the global view. Input tensors whose NON-batch dims may shard
+    across processes (the 'seq' mesh axis) go through put_global_rows
+    instead - trainer._put_data.
     """
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
     return jax.make_array_from_process_local_data(sharding, arr)
 
 
-def put_global_full(arr: np.ndarray, sharding) -> jax.Array:
-    """FULL (global-shaped) host value -> global array under any
-    sharding. Unlike put_global, correct for shardings that split over
-    devices owned by several processes (e.g. ZeRO-1 optimizer state):
-    each process materializes only the shards it owns."""
+def put_global_rows(arr: np.ndarray, sharding, global_shape,
+                    row_start: int) -> jax.Array:
+    """Host value covering THIS process's batch rows (dim 0 starting at
+    `row_start` of the global batch) and the FULL extent of every other
+    dim -> global array under any sharding.
+
+    Unlike put_global, correct when NON-batch dims shard across
+    processes (e.g. a cross-host 'seq' mesh axis - parallel/ring.py):
+    each device's callback slices its seq portion out of the full-seq
+    host rows instead of treating the host array as one pre-cut shard.
+    """
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
     arr = np.asarray(arr)
-    return jax.make_array_from_callback(
-        arr.shape, sharding, lambda idx: arr[idx])
+    global_shape = tuple(global_shape)
+
+    def cb(idx):
+        if not idx:  # 0-d leaf (scalar state, via put_global_full)
+            return arr
+        r0, r1, _ = idx[0].indices(global_shape[0])
+        return arr[(slice(r0 - row_start, r1 - row_start),)
+                   + tuple(idx[1:])]
+
+    return jax.make_array_from_callback(global_shape, sharding, cb)
+
+
+def put_global_full(arr: np.ndarray, sharding) -> jax.Array:
+    """FULL (global-shaped) host value -> global array under any
+    sharding (e.g. ZeRO-1 optimizer state split over devices owned by
+    several processes): the row_start=0 full-coverage special case of
+    put_global_rows."""
+    arr = np.asarray(arr)
+    return put_global_rows(arr, sharding, arr.shape, 0)
 
 
 def fetch_local(arr: jax.Array) -> np.ndarray:
