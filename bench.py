@@ -58,16 +58,19 @@ A100_IMAGES_PER_SEC = 10000.0
 _BENCH_PATH = os.path.abspath(__file__)
 
 
+def _alexnet_batch(rng, batch):
+    """The bench's input shape in ONE place (matches _ALEXNET_CONF)."""
+    return (rng.randn(batch, 3, 227, 227).astype(np.float32),
+            rng.randint(0, 1000, size=(batch, 1)).astype(np.float32))
+
+
 def _measure_compute(trainer, batch, steps):
     """Train-step-only throughput on pre-staged device buffers."""
     import jax
     rng = np.random.RandomState(0)
-    data = jax.device_put(
-        rng.randn(batch, 3, 227, 227).astype(np.float32),
-        trainer._batch_sharded)
-    label = jax.device_put(
-        rng.randint(0, 1000, size=(batch, 1)).astype(np.float32),
-        trainer._batch_sharded)
+    hdata, hlabel = _alexnet_batch(rng, batch)
+    data = jax.device_put(hdata, trainer._batch_sharded)
+    label = jax.device_put(hlabel, trainer._batch_sharded)
     mask = jax.device_put(np.ones(batch, np.float32),
                           trainer._batch_sharded)
     labels = {"label": label}
@@ -103,10 +106,8 @@ def _measure_e2e(trainer, batch, steps, profile_dir=""):
     # the RNG, identical ones would hide nothing - staging cost is the
     # same either way
     nbuf = min(8, steps)
-    batches = [DataBatch(
-        data=rng.randn(batch, 3, 227, 227).astype(np.float32),
-        label=rng.randint(0, 1000, (batch, 1)).astype(np.float32))
-        for _ in range(nbuf)]
+    batches = [DataBatch(*_alexnet_batch(rng, batch))
+               for _ in range(nbuf)]
     for i in range(2):  # warmup
         trainer.update(batches[i % nbuf])
     jax.block_until_ready(trainer.state)
@@ -172,6 +173,43 @@ def _bench_attention(platform: str) -> dict:
         return {"attn_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_top_ops(trainer, batch, platform: str) -> dict:
+    """Compact device profile of the already-compiled e2e step (TPU
+    only; no extra compile): 8 profiled updates -> top-5 ops by device
+    time as [[name, pct], ...]. The driver records the JSON artifact,
+    so this lands the step's time breakdown on every on-chip bench run.
+    Disable with CXN_BENCH_PROFILE=0."""
+    if platform != "tpu" or os.environ.get("CXN_BENCH_PROFILE") == "0":
+        return {}
+    try:
+        import glob
+        import tempfile
+
+        import jax
+        from cxxnet_tpu.io.data import DataBatch
+        from cxxnet_tpu.tools.profile_step import op_table
+        rng = np.random.RandomState(2)
+        db = DataBatch(*_alexnet_batch(rng, batch))
+        d = tempfile.mkdtemp(prefix="cxn_bench_prof_")
+        try:
+            jax.profiler.start_trace(d)
+            for _ in range(8):
+                trainer.update(db)
+            jax.block_until_ready(trainer.state)
+            jax.profiler.stop_trace()
+            xp = glob.glob(os.path.join(d, "**", "*.xplane.pb"),
+                           recursive=True)
+            rows, total = op_table(xp[0], top=5)
+        finally:
+            import shutil
+            shutil.rmtree(d, ignore_errors=True)
+        return {"top_ops": [[n[:60], round(100.0 * ns / max(total, 1), 1)]
+                            for n, ns in rows],
+                "profiled_device_ms": round(total / 1e6, 2)}
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"profile_error": f"{type(e).__name__}: {e}"}
+
+
 def run(profile_dir="", steps_override=0) -> dict:
     import jax
     from __graft_entry__ import _ALEXNET_CONF, _make_trainer
@@ -231,6 +269,7 @@ def run(profile_dir="", steps_override=0) -> dict:
         "per_device_batch": batch // ndev,
         "steps": steps,
     }
+    out.update(_bench_top_ops(trainer, batch, platform))
     out.update(_bench_attention(platform))
     if os.environ.get("CXN_BENCH_FALLBACK") == "1":
         src = os.environ.get("CXN_BENCH_FALLBACK_FROM", "default")

@@ -58,8 +58,10 @@ def capture(trace_dir: str, steps: int = 20) -> str:
     return max(paths, key=os.path.getmtime)
 
 
-def summarize(xplane_path: str, top: int = 25) -> str:
-    """Aggregate device-plane op self-times from an XSpace dump."""
+def op_table(xplane_path: str, top: int = 25):
+    """Aggregate device-plane op self-times from an XSpace dump.
+    Returns ([(op_name, total_ns)] sorted desc, total_ns) - the data
+    behind summarize(), reused by bench.py's compact top_ops field."""
     from jax.profiler import ProfileData
     data = ProfileData.from_file(xplane_path)
     dev_planes = [p for p in data.planes if "/device:" in p.name]
@@ -81,14 +83,18 @@ def summarize(xplane_path: str, top: int = 25) -> str:
                 name = ev.name
                 op_time[name] += dur
                 total += dur
-    rows = sorted(op_time.items(), key=lambda kv: -kv[1])[:top]
+    return sorted(op_time.items(), key=lambda kv: -kv[1])[:top], total
+
+
+def summarize(xplane_path: str, top: int = 25) -> str:
+    """Markdown table of op_table()."""
+    rows, total = op_table(xplane_path, top)
     out = ["| op | total ms | % of device time |",
            "|---|---|---|"]
     for name, ns in rows:
         out.append(f"| `{name[:70]}` | {ns / 1e6:.2f} | "
                    f"{100.0 * ns / max(total, 1):.1f}% |")
-    out.append(f"\nDevice planes: {[p.name for p in dev_planes]}; "
-               f"total accounted {total / 1e6:.1f} ms")
+    out.append(f"\nTotal accounted {total / 1e6:.1f} ms")
     return "\n".join(out)
 
 

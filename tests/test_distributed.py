@@ -136,14 +136,13 @@ def _single_process_reference(tmp_path, net=None, shape=(1, 1, 8),
     return w
 
 
-def _run_two_process(tmp_path, extra_cfg="", net="", shape="1,1,8",
-                     wkey="fc1"):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    out_prefix = str(tmp_path / "w")
+def _spawn_workers(argv, extra_env=None, nproc=2):
+    """Launch nproc coordinator-connected worker processes and return
+    their outputs; kills survivors if one times out (a dead peer leaves
+    the rest blocked inside collectives)."""
     port = _free_port()
     procs = []
-    for rank in range(2):
+    for rank in range(nproc):
         env = {k: v for k, v in os.environ.items() if "axon" not in v}
         env["PYTHONPATH"] = REPO
         env["JAX_PLATFORMS"] = "cpu"
@@ -151,23 +150,35 @@ def _run_two_process(tmp_path, extra_cfg="", net="", shape="1,1,8",
         # the pytest parent's 8-virtual-device XLA_FLAGS must not leak)
         env["XLA_FLAGS"] = ""
         env["CXN_COORDINATOR"] = f"127.0.0.1:{port}"
-        env["CXN_NUM_WORKER"] = "2"
+        env["CXN_NUM_WORKER"] = str(nproc)
         env["CXN_WORKER_RANK"] = str(rank)
-        env["CXN_TEST_REPO"] = REPO
-        env["CXN_TEST_OUT"] = out_prefix
-        env["CXN_TEST_EXTRA"] = extra_cfg
-        env["CXN_TEST_NET"] = net
-        env["CXN_TEST_SHAPE"] = shape
-        env["CXN_TEST_WKEY"] = wkey
+        env.update(extra_env or {})
         procs.append(subprocess.Popen(
-            [sys.executable, str(script)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=300)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
+    return outs
+
+
+def _run_two_process(tmp_path, extra_cfg="", net="", shape="1,1,8",
+                     wkey="fc1"):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out_prefix = str(tmp_path / "w")
+    _spawn_workers(
+        [sys.executable, str(script)],
+        {"CXN_TEST_REPO": REPO, "CXN_TEST_OUT": out_prefix,
+         "CXN_TEST_EXTRA": extra_cfg, "CXN_TEST_NET": net,
+         "CXN_TEST_SHAPE": shape, "CXN_TEST_WKEY": wkey})
     w0 = np.load(f"{out_prefix}.0.npy")
     w1 = np.load(f"{out_prefix}.1.npy")
     return w0, w1
@@ -258,23 +269,9 @@ param_server = dist
 mesh = data:1,seq:2
 silent = 1
 """)
-    port = _free_port()
-    procs = []
-    for rank in range(2):
-        env = {k: v for k, v in os.environ.items() if "axon" not in v}
-        env["PYTHONPATH"] = REPO
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = ""
-        env["CXN_COORDINATOR"] = f"127.0.0.1:{port}"
-        env["CXN_NUM_WORKER"] = "2"
-        env["CXN_WORKER_RANK"] = str(rank)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "cxxnet_tpu.main", str(conf)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
-    outs = [p.communicate(timeout=300)[0] for p in procs]
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out
+    outs = _spawn_workers(
+        [sys.executable, "-m", "cxxnet_tpu.main", str(conf)])
+    for out in outs:
         assert "diverge" not in out, out
     # both workers saw the same data: identical train-error lines
     lines = [next(l for l in out.splitlines() if "train-error" in l)

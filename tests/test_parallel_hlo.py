@@ -17,9 +17,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cxxnet_tpu.parallel import ring as R
 
-# any element type (f32, bf16, s32, ...): a bf16 collective must not
-# slip past the no-all-gather assertions
-_SHAPE = re.compile(r"\b[a-z]{1,4}\d{1,2}\[([0-9,]*)\]")
+# any HLO element type (f32, bf16, s32, pred, f8e4m3, ...): a
+# non-f32 collective must not slip past the no-all-gather assertions
+_SHAPE = re.compile(r"\b\w+\[([0-9,]*)\]")
 
 
 def _count(hlo: str, op: str) -> int:
