@@ -316,6 +316,8 @@ def main(argv) -> int:
         # re-exec the whole process onto the CPU backend so the harness
         # still produces a real (clearly-labeled) number; second
         # occurrence: emit the error artifact and exit cleanly.
+        if _PARTIAL.get("done"):
+            return  # main thread is printing the full result itself
         if _PARTIAL.get("value"):
             _PARTIAL["truncated"] = (
                 f"extras cut at the {budget}s watchdog")
@@ -344,6 +346,9 @@ def main(argv) -> int:
         t.start()
     try:
         out = run(profile_dir, steps)
+        # a timer firing between here and cancel() must not emit a
+        # second (truncated-marked) JSON line - the contract is ONE
+        _PARTIAL["done"] = True
     except BaseException as e:  # noqa: BLE001 - always emit the JSON line
         print(_error_json(f"{type(e).__name__}: {e}"))
         return 0

@@ -9,20 +9,23 @@ all-to-all dispatch:
   expert dim (w1 (E, H, e), w2 (E, e, H)); `expert_shard_dims` shards
   that dim over an 'expert' mesh axis the same way `model_shard_dims`
   drives tensor parallelism (parallel/sharding.py).
-- compute is the dense formulation: every expert runs on every token
-  and the router's top-k one-hot (scaled by the softmax prob, the
-  Switch-Transformer estimator) masks the sum. Under an expert-sharded
-  mesh each device computes only its local experts for all tokens and
-  one psum combines - the all-to-all-free EP layout. Per-device FLOPs
-  equal one dense FFN times E/n_expert_shards; there is no token
-  dropping and no capacity factor to tune.
+- two compute routes. Default (dense, exact): every expert runs on
+  every token and the router's top-k one-hot (scaled by the softmax
+  prob, the Switch-Transformer estimator) masks the sum; under an
+  expert-sharded mesh each device computes only its local experts for
+  all tokens and one psum combines - the all-to-all-free EP layout
+  with no token dropping. `moe_capacity > 0` switches to Switch/GShard
+  capacity-based sparse dispatch (per-device FLOPs O(top_k x dense)
+  regardless of E, overflow tokens dropped) - the large-E perf route.
 - the standard load-balance auxiliary loss (E * sum_e fraction_e *
   mean_prob_e) is returned through the `apply_with_aux` protocol
   (nnet/network.py adds it into total_loss; `moe_aux` scales it, 0
   disables).
 
 Config keys: nexpert, nhidden (per-expert FFN hidden), moe_top_k
-(default 1), moe_aux (default 0.01), no_bias.
+(default 1), moe_aux (default 0.01), moe_capacity (0 = dense exact
+compute; >0 = Switch/GShard capacity-factor sparse dispatch, tokens
+over capacity dropped), no_bias.
 """
 
 from __future__ import annotations
@@ -31,6 +34,7 @@ from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cxxnet_tpu.layers.base import Layer, Params, Shape, register_layer
 
@@ -46,6 +50,7 @@ class MoELayer(Layer):
         self.nexpert = 0
         self.top_k = 1
         self.aux_scale = 0.01
+        self.capacity = 0.0   # 0 = dense (exact); >0 = sparse dispatch
 
     def set_param(self, name: str, val: str) -> None:
         super().set_param(name, val)
@@ -55,6 +60,8 @@ class MoELayer(Layer):
             self.top_k = int(val)
         if name == "moe_aux":
             self.aux_scale = float(val)
+        if name == "moe_capacity":
+            self.capacity = float(val)
 
     def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
         self.check_one_to_one(in_shapes)
@@ -95,8 +102,10 @@ class MoELayer(Layer):
         # logits are needed for every token on every expert shard
         return {"w1": 0, "w2": 0, "b1": 0, "b2": 0}
 
-    def _route(self, probs, mask=None):
-        """(b, s, E) probs -> (combine (b, s, E), aux scalar).
+    def _route(self, probs, mask=None, need_combine=True):
+        """(b, s, E) probs -> (combine (b, s, E) or None, aux scalar,
+        topv (b, s, k), topi (b, s, k)). The top-k tensors are computed
+        ONCE here and reused by whichever compute route runs.
 
         `mask` is the (b,) padded-batch validity mask: padding rows
         must not skew the load-balance statistics (their task loss is
@@ -104,7 +113,8 @@ class MoELayer(Layer):
         topv, topi = jax.lax.top_k(probs, self.top_k)
         onehot = jax.nn.one_hot(topi, self.nexpert,
                                 dtype=probs.dtype)  # (b, s, k, E)
-        combine = jnp.sum(onehot * topv[..., None], axis=2)
+        combine = (jnp.sum(onehot * topv[..., None], axis=2)
+                   if need_combine else None)
         # load-balance loss (Switch Transformer eq. 4): fraction of
         # tokens routed to e (top-1 assignment) x mean router prob
         top1 = jnp.sum(onehot[:, :, :1], axis=2)     # (b, s, E)
@@ -117,9 +127,69 @@ class MoELayer(Layer):
             frac = jnp.mean(top1, axis=(0, 1))
             mean_p = jnp.mean(probs, axis=(0, 1))
         aux = self.nexpert * jnp.sum(frac * mean_p)
-        return combine, aux
+        return combine, aux, topv, topi
 
     has_aux = True
+
+    def _dense_compute(self, params, xs, combine):
+        """Every expert on every token, masked by `combine` (b, s, E):
+        exact, no token dropping; per-device FLOPs = dense x E/n under
+        expert sharding."""
+        h1 = jnp.einsum("bse,ghe->bsgh", xs,
+                        params["w1"].astype(xs.dtype))
+        if "b1" in params:
+            h1 = h1 + params["b1"].astype(xs.dtype)[None, None]
+        h1 = jnp.maximum(h1, 0.0)
+        ye = jnp.einsum("bsgh,geh->bsge", h1,
+                        params["w2"].astype(xs.dtype))
+        if "b2" in params:
+            ye = ye + params["b2"].astype(xs.dtype)[None, None]
+        return jnp.einsum("bsge,bsg->bse", ye, combine.astype(xs.dtype))
+
+    def _sparse_compute(self, params, xs, topv, topi, mask=None):
+        """Capacity-based dispatch (Switch/GShard style): each expert
+        processes at most C = ceil(top_k * tokens/E * moe_capacity)
+        tokens; per-device FLOPs are O(top_k x dense) regardless of E,
+        at the cost of DROPPING tokens that overflow an expert's buffer
+        (their MoE output is 0; the residual connection still carries
+        them). Chosen over the dense route when `moe_capacity > 0`.
+        Padding rows (`mask`) claim no capacity - a padded batch must
+        not displace real tokens' expert slots."""
+        b, s, e = xs.shape
+        t = b * s
+        E, k = self.nexpert, self.top_k
+        cap = int(np.ceil(k * t / E * self.capacity))
+        cap = max(1, min(cap, t))
+        xt = xs.reshape(t, e)
+        dt = topv.dtype
+        topv = topv.reshape(t, k)
+        assign = jax.nn.one_hot(topi.reshape(t, k), E,
+                                dtype=dt)              # (t, k, E)
+        if mask is not None:
+            tok = jnp.repeat(mask.astype(dt), s)       # (t,)
+            assign = assign * tok[:, None, None]
+        # position of each (token, slot) inside its expert's buffer:
+        # cumulative count over the flattened (slot-major) order, so
+        # k=1 assignments win buffer space before second choices
+        flat = jnp.moveaxis(assign, 1, 0).reshape(k * t, E)
+        pos = jnp.cumsum(flat, axis=0) - flat          # arrivals before
+        pos = jnp.moveaxis(pos.reshape(k, t, E), 0, 1)  # (t, k, E)
+        pos = jnp.sum(pos * assign, axis=2).astype(jnp.int32)  # (t, k)
+        keep = (pos < cap).astype(dt)
+        slot = jax.nn.one_hot(pos, cap, dtype=dt)  # (t, k, cap)
+        # dispatch (t, E, cap): 1 where token t sits in expert e slot c
+        disp = jnp.einsum("tke,tkc,tk->tec", assign, slot, keep)
+        comb = jnp.einsum("tec,tk,tke->tec", disp, topv, assign)
+        ein = jnp.einsum("tec,td->ecd", disp.astype(xt.dtype), xt)
+        h1 = jnp.einsum("ecd,ehd->ech", ein, params["w1"].astype(xt.dtype))
+        if "b1" in params:
+            h1 = h1 + params["b1"].astype(xt.dtype)[:, None]
+        h1 = jnp.maximum(h1, 0.0)
+        ye = jnp.einsum("ech,edh->ecd", h1, params["w2"].astype(xt.dtype))
+        if "b2" in params:
+            ye = ye + params["b2"].astype(xt.dtype)[:, None]
+        out = jnp.einsum("tec,ecd->td", comb.astype(xt.dtype), ye)
+        return out.reshape(b, s, e)
 
     def apply_with_aux(self, params, inputs, *, train, rng=None,
                        mask=None) -> Tuple[List[jax.Array], jax.Array]:
@@ -129,18 +199,15 @@ class MoELayer(Layer):
         logits = jnp.einsum("bse,ge->bsg", xs,
                             params["gate"].astype(x.dtype))
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        combine, aux = self._route(probs, mask)
-        # dense expert compute; the expert dim g rides the 'expert'
-        # mesh axis, so each device computes its local experts only
-        h1 = jnp.einsum("bse,ghe->bsgh", xs, params["w1"].astype(x.dtype))
-        if "b1" in params:
-            h1 = h1 + params["b1"].astype(x.dtype)[None, None]
-        h1 = jnp.maximum(h1, 0.0)
-        ye = jnp.einsum("bsgh,geh->bsge", h1,
-                        params["w2"].astype(x.dtype))
-        if "b2" in params:
-            ye = ye + params["b2"].astype(x.dtype)[None, None]
-        out = jnp.einsum("bsge,bsg->bse", ye, combine.astype(x.dtype))
+        sparse = self.capacity > 0
+        combine, aux, topv, topi = self._route(
+            probs, mask, need_combine=not sparse)
+        if sparse:
+            out = self._sparse_compute(params, xs, topv, topi, mask)
+        else:
+            # dense expert compute; the expert dim g rides the 'expert'
+            # mesh axis, so each device computes its local experts only
+            out = self._dense_compute(params, xs, combine)
         # scaled by batch so the trainer's 1/(batch*update_period)
         # normalization leaves the aux term batch-size-invariant
         aux_term = (self.aux_scale * b) * aux if self.aux_scale else \

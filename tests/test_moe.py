@@ -36,9 +36,11 @@ eval_train = 0
 """
 
 
-def _make(mesh: str) -> NetTrainer:
+def _make(mesh: str, extra: str = "") -> NetTrainer:
     t = NetTrainer()
-    for k, v in parse_config_string(MOE_NET):
+    net = MOE_NET if not extra else MOE_NET.replace(
+        "moe_top_k = 2", "moe_top_k = 2\n  " + extra)
+    for k, v in parse_config_string(net):
         t.set_param(k, v)
     if mesh:
         t.set_param("mesh", mesh)
@@ -145,6 +147,59 @@ def test_aux_loss_ignores_padding_rows():
     # aux_term scales by the (padded) batch dim: 4 vs 2
     np.testing.assert_allclose(float(aux_pad) / 4.0,
                                float(aux_ref) / 2.0, rtol=1e-5)
+
+
+def test_sparse_dispatch_equals_dense_with_ample_capacity():
+    """moe_capacity large enough that nothing drops: the sparse
+    gather/scatter route must equal the dense masked-sum exactly (same
+    per-token expert outputs, same prob weights)."""
+    m = _layer(nexpert=4, nhidden=8, top_k=2)
+    m.infer_shapes([(2, 1, 8, 8)])
+    params = m.init_params(jax.random.PRNGKey(2), [(2, 1, 8, 8)])
+    x = np.random.RandomState(4).randn(2, 1, 8, 8).astype(np.float32)
+    (dense,), _ = m.apply_with_aux(params, [x], train=True)
+    m.set_param("moe_capacity", "4.0")  # cap = t, cannot drop
+    (sparse,), _ = m.apply_with_aux(params, [x], train=True)
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_dispatch_drops_overflow_tokens():
+    """Tiny capacity: overflowing tokens get a zero MoE output (their
+    residual path carries them) - never NaN, and the kept tokens still
+    match the dense computation."""
+    m = _layer(nexpert=2, nhidden=8, top_k=1)
+    m.set_param("moe_capacity", "0.25")
+    m.infer_shapes([(1, 1, 8, 8)])
+    params = m.init_params(jax.random.PRNGKey(5), [(1, 1, 8, 8)])
+    # drive every token to expert 0 so capacity must overflow
+    params["gate"] = params["gate"].at[0].set(5.0).at[1].set(-5.0)
+    x = np.random.RandomState(6).randn(1, 1, 8, 8).astype(np.float32)
+    (y,), _ = m.apply_with_aux(params, [x], train=True)
+    y = np.asarray(y)[0, 0]
+    assert np.all(np.isfinite(y))
+    # cap = ceil(1 * 8/2 * 0.25) = 1: at most one token kept per
+    # expert (sign of sum(x_t) picks the expert under this gate)
+    nonzero = np.abs(y).sum(axis=1) > 0
+    assert 1 <= nonzero.sum() <= 2, nonzero
+    m2 = _layer(nexpert=2, nhidden=8, top_k=1)
+    m2.infer_shapes([(1, 1, 8, 8)])
+    (dense,), _ = m2.apply_with_aux(params, [x], train=True)
+    np.testing.assert_allclose(y[nonzero],
+                               np.asarray(dense)[0, 0][nonzero],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_expert_parallel_equals_single_device():
+    ep = _make("data:2,expert:2", extra="moe_capacity = 4.0")
+    base = _make("", extra="moe_capacity = 4.0")
+    for b in _batches():
+        base.update(b)
+        ep.update(b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(base.state["params"])),
+                    jax.tree.leaves(jax.device_get(ep.state["params"]))):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-5)
 
 
 def test_expert_parallel_equals_single_device():
