@@ -60,8 +60,11 @@ _BENCH_PATH = os.path.abspath(__file__)
 # headline results land here as soon as they are measured; if the
 # watchdog fires during the OPTIONAL extras (top-ops profile, attention
 # micro-bench), it prints these instead of throwing away a completed
-# on-chip measurement with a CPU re-exec
+# on-chip measurement with a CPU re-exec. _EMIT_LOCK serializes the
+# "who prints the one JSON line" decision between the main thread and
+# the watchdog timer.
 _PARTIAL: dict = {}
+_EMIT_LOCK = threading.Lock()
 
 
 def _alexnet_batch(rng, batch):
@@ -316,13 +319,17 @@ def main(argv) -> int:
         # re-exec the whole process onto the CPU backend so the harness
         # still produces a real (clearly-labeled) number; second
         # occurrence: emit the error artifact and exit cleanly.
-        if _PARTIAL.get("done"):
-            return  # main thread is printing the full result itself
-        if _PARTIAL.get("value"):
-            _PARTIAL["truncated"] = (
-                f"extras cut at the {budget}s watchdog")
-            print(json.dumps(_PARTIAL), flush=True)
-            os._exit(0)
+        with _EMIT_LOCK:
+            if _PARTIAL.get("emitted"):
+                return  # main thread already printed the full result
+            if _PARTIAL.get("value"):
+                _PARTIAL["emitted"] = True
+                _PARTIAL["truncated"] = (
+                    f"extras cut at the {budget}s watchdog")
+                print(json.dumps(
+                    {k: v for k, v in _PARTIAL.items()
+                     if k != "emitted"}), flush=True)
+                os._exit(0)
         prior = os.environ.get("JAX_PLATFORMS", "")
         if os.environ.get("CXN_BENCH_FALLBACK") != "1" and prior != "cpu":
             sys.stderr.write(
@@ -346,9 +353,13 @@ def main(argv) -> int:
         t.start()
     try:
         out = run(profile_dir, steps)
-        # a timer firing between here and cancel() must not emit a
-        # second (truncated-marked) JSON line - the contract is ONE
-        _PARTIAL["done"] = True
+        # claim the single JSON line under the lock: a timer firing in
+        # this window must neither double-print nor mislabel a full
+        # run as truncated
+        with _EMIT_LOCK:
+            if _PARTIAL.get("emitted"):
+                return 0  # watchdog already printed the partial line
+            _PARTIAL["emitted"] = True
     except BaseException as e:  # noqa: BLE001 - always emit the JSON line
         print(_error_json(f"{type(e).__name__}: {e}"))
         return 0

@@ -156,3 +156,19 @@ def test_bf16_inputs_stay_stable():
     assert out.dtype == jnp.bfloat16
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+def test_naive_attention_matches_torch():
+    """Ground truth beyond self-consistency: torch's
+    scaled_dot_product_attention on the same tensors."""
+    torch = pytest.importorskip("torch")
+    F = torch.nn.functional
+    q, k, v = _qkv(b=2, h=3, s=16, d=8)
+    for causal in (False, True):
+        ref = F.scaled_dot_product_attention(
+            torch.from_numpy(np.asarray(q)),
+            torch.from_numpy(np.asarray(k)),
+            torch.from_numpy(np.asarray(v)), is_causal=causal).numpy()
+        out = A.naive_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), ref,
+                                   rtol=1e-5, atol=1e-5)

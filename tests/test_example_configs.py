@@ -21,6 +21,7 @@ REPO = __file__.rsplit("/tests/", 1)[0]
     ("examples/MNIST/MNIST.conf", 10, {}),
     ("examples/MNIST/MNIST_CONV.conf", 10, {}),
     ("examples/LongSeq/seq_mnist.conf", 10, {}),
+    ("examples/LongSeq/stack_moe.conf", 10, {}),
 ])
 def test_example_config_shapes(conf, final_dim, checks):
     cfg = NetConfig()
