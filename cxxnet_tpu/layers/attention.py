@@ -162,6 +162,20 @@ class AttentionLayer(Layer):
 
 
 @register_layer
+class AttentionNaiveLayer(AttentionLayer):
+    """attention_naive: the attention layer with the full-matrix naive
+    core - the trusted slave for the pairtest harness
+    (`pairtest-attention-attention_naive`), mirroring how conv_im2col
+    backs the MXU conv (layers/pairtest.py)."""
+
+    type_name = "attention_naive"
+
+    def _core(self, q, k, v):
+        return ops_attn.naive_attention(q, k, v,
+                                        causal=bool(self.causal))
+
+
+@register_layer
 class SeqFullcLayer(Layer):
     """seq_fullc: position-wise fully-connected on (b, 1, s, e) sequence
     nodes -> (b, 1, s, nhidden); the transformer FFN building block.

@@ -330,6 +330,15 @@ class SoftplusLayer(ActivationLayer):
 
 
 @register_layer
+class GeluLayer(ActivationLayer):
+    """gelu (tanh approximation): no reference analog - extension for
+    the transformer family (layers/attention.py), where relu's dead
+    zones cost accuracy in FFNs."""
+    type_name = "gelu"
+    fn = staticmethod(ops.gelu)
+
+
+@register_layer
 class XeluLayer(ActivationLayer):
     """xelu: x > 0 ? x : x / b, b default 5.0 (xelu_layer-inl.hpp:15-53)."""
 

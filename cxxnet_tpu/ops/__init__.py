@@ -12,10 +12,11 @@ hand-written Backprop methods.
 from cxxnet_tpu.ops.pooling import pool2d, pool_out_dim, insanity_pool2d
 from cxxnet_tpu.ops.conv import conv2d, conv_out_dim
 from cxxnet_tpu.ops.nn import (
-    relu, sigmoid, tanh, softplus, xelu, mxelu, softmax, lrn)
+    relu, sigmoid, tanh, softplus, gelu, xelu, mxelu, softmax, lrn)
 
 __all__ = [
     "pool2d", "pool_out_dim", "insanity_pool2d",
     "conv2d", "conv_out_dim",
-    "relu", "sigmoid", "tanh", "softplus", "xelu", "mxelu", "softmax", "lrn",
+    "relu", "sigmoid", "tanh", "softplus", "gelu", "xelu", "mxelu",
+    "softmax", "lrn",
 ]

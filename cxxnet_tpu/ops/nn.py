@@ -29,6 +29,10 @@ def softplus(x):
     return jax.nn.softplus(x)
 
 
+def gelu(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
 def xelu(x, b):
     """Leaky relu variant: x > 0 ? x : x / b (op.h:50-55)."""
     return jnp.where(x > 0, x, x / b)

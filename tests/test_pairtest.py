@@ -92,3 +92,26 @@ input_shape = 3,8,8
     assert out.shape == (2, 1, 1, 10)
     np.testing.assert_allclose(out.reshape(2, 10).sum(axis=1), 1.0,
                                rtol=1e-5)
+
+
+@pytest.mark.parametrize("cfg", [
+    dict(nhead=2, causal=0),
+    dict(nhead=4, causal=1, kv_block=4),
+])
+def test_attention_vs_naive(cfg):
+    """Production blockwise/flash attention core vs the full-matrix
+    naive core, through the framework's own differential harness."""
+    layer = _mk("pairtest-attention-attention_naive", cfg)
+    report = run_pairtest(layer, [(2, 1, 8, 16)])
+    for k, err in report.items():
+        assert err < TOL, (k, err, report)
+
+
+def test_gelu_matches_torch():
+    torch = pytest.importorskip("torch")
+    import cxxnet_tpu.ops as ops
+    x = np.random.RandomState(0).randn(64).astype(np.float32)
+    ref = torch.nn.functional.gelu(torch.from_numpy(x),
+                                   approximate="tanh").numpy()
+    np.testing.assert_allclose(np.asarray(ops.gelu(x)), ref,
+                               rtol=1e-5, atol=1e-6)
