@@ -17,23 +17,41 @@ pytestmark = pytest.mark.skipif(
     reason="slow acceptance run; set CXN_RUN_ACCEPTANCE=1")
 
 
-def test_conv_digits_accuracy(tmp_path, capfd):
+def _run_acceptance(conf_rel, tmp_path, capfd, extra=()):
+    """Build the real-digits idx files, run the example config through
+    the CLI task driver, return the final test error."""
     from cxxnet_tpu.main import LearnTask
     from cxxnet_tpu.tools.digits_to_idx import build
 
     build(str(tmp_path / "data"))
-    conf_src = os.path.join(os.path.dirname(__file__), "..",
-                            "examples", "MNIST", "MNIST_CONV.conf")
-    conf = str(tmp_path / "MNIST_CONV.conf")
+    conf_src = os.path.join(os.path.dirname(__file__), "..", *conf_rel)
+    conf = str(tmp_path / os.path.basename(conf_src))
     shutil.copy(conf_src, conf)
     cwd = os.getcwd()
     os.chdir(tmp_path)
     try:
         LearnTask().run([conf, "dev=cpu", "silent=1", "num_round=40",
-                         "max_round=40", "save_model=0"])
+                         "max_round=40", "save_model=0", *extra])
     finally:
         os.chdir(cwd)
     err = capfd.readouterr().err
     last = [l for l in err.strip().splitlines() if "test-error" in l][-1]
-    test_err = float(re.search(r"test-error:([0-9.]+)", last).group(1))
+    return float(re.search(r"test-error:([0-9.]+)", last).group(1)), last
+
+
+def test_conv_digits_accuracy(tmp_path, capfd):
+    test_err, last = _run_acceptance(
+        ("examples", "MNIST", "MNIST_CONV.conf"), tmp_path, capfd)
     assert test_err <= 0.02, f"acceptance failed: {last}"  # >=98%
+
+
+def test_seq_transformer_digits_accuracy(tmp_path, capfd):
+    """The LongSeq transformer example (sequential row-reading of the
+    same real handwritten digits) reaches >=95% - acceptance for the
+    sequence-model family (docs/acceptance/digits_seq_log.txt). The
+    example ships dtype=bf16 for TPU; CPU emulates bf16 pathologically
+    slowly, so the acceptance run overrides to f32."""
+    test_err, last = _run_acceptance(
+        ("examples", "LongSeq", "seq_mnist.conf"), tmp_path, capfd,
+        extra=("dtype=float32",))
+    assert test_err <= 0.05, f"seq acceptance failed: {last}"  # >=95%
