@@ -281,9 +281,12 @@ def run(profile_dir="", steps_override=0) -> dict:
     # headline complete: the watchdog now emits this rather than
     # re-execing away a finished on-chip measurement; re-snapshot after
     # each extra so a completed extra survives the next one hanging
-    _PARTIAL.update(out)
+    # (under the lock: the watchdog iterates _PARTIAL concurrently)
+    with _EMIT_LOCK:
+        _PARTIAL.update(out)
     out.update(_bench_top_ops(trainer, batch, platform))
-    _PARTIAL.update(out)
+    with _EMIT_LOCK:
+        _PARTIAL.update(out)
     out.update(_bench_attention(platform))
     if os.environ.get("CXN_BENCH_FALLBACK") == "1":
         src = os.environ.get("CXN_BENCH_FALLBACK_FROM", "default")

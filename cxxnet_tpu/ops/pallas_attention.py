@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from cxxnet_tpu.ops.attention import _scale
+
 _NEG = -1e30
 
 # default tile sizes: (128, 128) score tiles feed the MXU exactly;
@@ -117,7 +119,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
 
 def _fwd(q, k, v, scale, causal, interpret) -> Tuple[jax.Array, jax.Array]:
     b, h, s, d = q.shape
-    bq, bk = _blocks(s, BLOCK_Q), _blocks(k.shape[2], BLOCK_K)
+    sub = _sublane(q.dtype)
+    bq, bk = _blocks(s, BLOCK_Q, sub), _blocks(k.shape[2], BLOCK_K, sub)
     nq, nkv = s // bq, k.shape[2] // bk
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              bq=bq, bk=bk, nkv=nkv)
@@ -233,7 +236,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret):
     b, h, s, d = q.shape
     sk = k.shape[2]
-    bq, bk = _blocks(s, BLOCK_Q), _blocks(sk, BLOCK_K)
+    sub = _sublane(q.dtype)
+    bq, bk = _blocks(s, BLOCK_Q, sub), _blocks(sk, BLOCK_K, sub)
     nq, nkv = s // bq, sk // bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # (b, h, s)
@@ -287,20 +291,20 @@ def flash_attention(q, k, v, causal: bool = False,
                     interpret: bool = False):
     """Fused TPU attention; semantics == ops.attention.naive_attention.
     [B, H, S, D] in/out; O(S) memory; causal skips future tiles."""
-    sc = (1.0 / (q.shape[-1] ** 0.5)) if scale is None else scale
+    sc = _scale(q, scale)
     o, _ = _fwd(q, k, v, sc, causal, interpret)
     return o
 
 
 def _vjp_fwd(q, k, v, causal, scale, interpret):
-    sc = (1.0 / (q.shape[-1] ** 0.5)) if scale is None else scale
+    sc = _scale(q, scale)
     o, lse = _fwd(q, k, v, sc, causal, interpret)
     return o, (q, k, v, o, lse)
 
 
 def _vjp_bwd(causal, scale, interpret, res, do):
     q, k, v, o, lse = res
-    sc = (1.0 / (q.shape[-1] ** 0.5)) if scale is None else scale
+    sc = _scale(q, scale)
     return _bwd_impl(q, k, v, o, lse, do, sc, causal, interpret)
 
 
