@@ -288,6 +288,8 @@ def run(profile_dir="", steps_override=0) -> dict:
     with _EMIT_LOCK:
         _PARTIAL.update(out)
     out.update(_bench_attention(platform))
+    with _EMIT_LOCK:
+        _PARTIAL.update(out)
     if os.environ.get("CXN_BENCH_FALLBACK") == "1":
         src = os.environ.get("CXN_BENCH_FALLBACK_FROM", "default")
         out["fallback"] = (f"backend '{src}' hung; CPU harness run")

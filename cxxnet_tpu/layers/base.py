@@ -191,6 +191,37 @@ class Layer:
 
 
 # ---------------------------------------------------------------------------
+# ambient training-step binding
+# ---------------------------------------------------------------------------
+
+_ACTIVE_STEP: List[Optional[jax.Array]] = [None]
+
+
+class active_step:
+    """Context binding 'the (traced) update counter this forward runs
+    at' so layers whose behavior is a function of training progress
+    (insanity's per-forward anneal, insanity_layer-inl.hpp:52-63) can
+    read it without threading a step argument through every
+    Layer.apply. The trainer enters it around net.forward inside the
+    traced train step (same pattern as parallel.mesh.active_mesh)."""
+
+    def __init__(self, step: Optional[jax.Array]):
+        self.step = step
+
+    def __enter__(self):
+        _ACTIVE_STEP.append(self.step)
+        return self.step
+
+    def __exit__(self, *exc):
+        _ACTIVE_STEP.pop()
+        return False
+
+
+def get_active_step() -> Optional[jax.Array]:
+    return _ACTIVE_STEP[-1]
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 

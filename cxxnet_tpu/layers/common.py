@@ -362,11 +362,17 @@ class InsanityLayer(ActivationLayer):
     """insanity / RReLU (insanity_layer-inl.hpp:14-102).
 
     Train: xelu with per-element random divisor uniform in [lb, ub];
-    eval: fixed divisor (lb+ub)/2. The [lb, ub] range anneals toward its
-    midpoint between calm_start and calm_end; the reference advances the
-    annealing once per Forward call - here `anneal_step()` is invoked by the
-    trainer once per round (per-round rather than per-batch granularity,
-    since lb/ub are compile-time constants of the jitted step).
+    eval: fixed divisor (lb+ub)/2. The [lb, ub] range anneals toward
+    its midpoint, advancing once per training forward exactly like the
+    reference (insanity_layer-inl.hpp:52-63): the traced update counter
+    (base.get_active_step, bound by the trainer inside the jitted step)
+    drives a closed form of the reference's recurrence, including its
+    freeze quirk - the reference's internal counter only increments
+    INSIDE the (calm_start, calm_end) window, so with calm_start >= 0
+    it never leaves 0 and no annealing ever happens. The midpoint is
+    anneal-invariant, so eval needs no step. One deliberate deviation
+    remains: reference EVAL forwards also advance the counter (clearly
+    unintended); here only training steps count (docs/layer.md).
     """
 
     type_name = "insanity"
@@ -377,8 +383,6 @@ class InsanityLayer(ActivationLayer):
         self.ub = 10.0
         self.saturation_start = 0
         self.saturation_end = 0
-        self._step = 0
-        self._delta: Optional[float] = None
 
     def set_param(self, name: str, val: str) -> None:
         super().set_param(name, val)
@@ -391,22 +395,39 @@ class InsanityLayer(ActivationLayer):
         if name == "calm_end":
             self.saturation_end = int(val)
 
-    def anneal_step(self) -> None:
-        if self._delta is None:
-            mid = (self.ub + self.lb) / 2.0
-            span = self.saturation_end - self.saturation_start
-            self._delta = (self.ub - mid) / span if span else 0.0
-        if self.saturation_start < self._step < self.saturation_end:
-            self.ub -= self._delta * self._step
-            self.lb += self._delta * self._step
-        self._step += 1
+    def _range(self):
+        """(lb, ub) at the current training step (traced when inside
+        the jitted step; the static initial range otherwise)."""
+        from cxxnet_tpu.layers.base import get_active_step
+        step = get_active_step()
+        s0, e = self.saturation_start, self.saturation_end
+        span = e - s0
+        if step is None or s0 >= 0 or span <= 0 or e <= 0:
+            # no step binding (direct layer use), the reference's
+            # frozen configurations (counter can never pass a
+            # non-negative calm_start), or a degenerate window
+            return self.lb, self.ub
+        delta = (self.ub - (self.ub + self.lb) / 2.0) / span
+        # the reference applies its event (shift by delta*counter, then
+        # counter++) BEFORE masking in the same Forward, so training
+        # step t reflects events 0..t (m = t+1 of them, capped at e):
+        # cumulative shift = delta * triangular(m) = delta*m(m-1)/2
+        m = jnp.clip(step + 1, 0, e).astype(jnp.float32)
+        adj = delta * m * (m - 1.0) / 2.0
+        return self.lb + adj, self.ub - adj
 
     def apply(self, params, inputs, *, train, rng=None):
         x = inputs[0]
         if train:
+            lb, ub = self._range()
+            if not isinstance(lb, float):
+                # keep the compute dtype: the f32 traced bounds must
+                # not promote a bf16 activation path
+                lb, ub = lb.astype(x.dtype), ub.astype(x.dtype)
             u = jax.random.uniform(rng, x.shape, dtype=x.dtype)
-            divisor = u * (self.ub - self.lb) + self.lb
+            divisor = u * (ub - lb) + lb
             return [ops.xelu(x, divisor)]
+        # the midpoint is invariant under the symmetric anneal
         return [ops.xelu(x, (self.lb + self.ub) / 2.0)]
 
 

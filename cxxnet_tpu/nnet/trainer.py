@@ -342,11 +342,12 @@ class NetTrainer:
                 rows.append(jnp.stack([s, c]))
             return jnp.stack(rows)
 
+        from cxxnet_tpu.layers.base import active_step
         from cxxnet_tpu.parallel.mesh import active_mesh
 
-        def loss_fn(params, data, labels, mask, rng):
+        def loss_fn(params, data, labels, mask, rng, step):
             cparams = self._cast(params)
-            with active_mesh(self.mesh):
+            with active_mesh(self.mesh), active_step(step):
                 values, loss = net.forward(
                     cparams, {0: self._cast(data)}, train=True, rng=rng,
                     labels=labels, mask=mask)
@@ -364,9 +365,12 @@ class NetTrainer:
             loss_fn = jax.checkpoint(loss_fn)
 
         def train_step(state, data, labels, mask, rng):
+            # per-forward training-step counter (updates so far) for
+            # step-dependent layers (insanity anneal)
+            step = state["epoch"] * update_period + state["count"]
             (loss, outs), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state["params"], data, labels, mask,
-                                       rng)
+                                       rng, step)
             accum = jax.tree.map(jnp.add, state["accum"], grads)
             count = state["count"] + 1
             do_update = count >= update_period
@@ -474,9 +478,6 @@ class NetTrainer:
                 sys.stderr.write(self.profiler.summary() + "\n")
             self.profiler.round_end()
             self.profiler.round_start()
-        for layer in (self.net.layer_objs if self.net else []):
-            if hasattr(layer, "anneal_step"):
-                layer.anneal_step()
 
     def finish_round_profile(self) -> None:
         """Close the round's trace right after the update loop so the

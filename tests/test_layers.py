@@ -419,3 +419,38 @@ def test_kaiming_init_fullc_uses_nhidden():
     _, params = run(layer, [np.zeros((1, 1, 1, 100), np.float32)])
     w = np.asarray(params["wmat"])
     assert abs(w.std() - np.sqrt(2.0 / 800)) < 0.01
+
+
+def test_insanity_anneal_matches_reference_recurrence():
+    """The closed-form per-forward anneal equals the reference's
+    literal loop (insanity_layer-inl.hpp:52-63), including the freeze
+    quirk for calm_start >= 0."""
+    from cxxnet_tpu.layers.base import active_step
+
+    def oracle(lb0, ub0, s0, e, t):
+        lb, ub, step_ = lb0, ub0, 0
+        mid = (ub0 + lb0) / 2.0
+        delta = (ub0 - mid) / (e - s0) if e != s0 else 0.0
+        for _ in range(t + 1):          # anneal runs BEFORE masking
+            if s0 < step_ < e:
+                ub -= delta * step_
+                lb += delta * step_
+                step_ += 1
+        return lb, ub
+
+    for s0, e in ((-1, 5), (-3, 5), (0, 5), (2, 5), (-1, 0)):
+        layer = make("insanity", [("lb", "2"), ("ub", "10"),
+                                  ("calm_start", str(s0)),
+                                  ("calm_end", str(e))])
+        for t in range(9):
+            with active_step(jnp.asarray(t, jnp.int32)):
+                lb, ub = layer._range()
+            lb_ref, ub_ref = oracle(2.0, 10.0, s0, e, t)
+            np.testing.assert_allclose(float(lb), lb_ref, rtol=1e-6,
+                                       err_msg=f"s0={s0} e={e} t={t}")
+            np.testing.assert_allclose(float(ub), ub_ref, rtol=1e-6,
+                                       err_msg=f"s0={s0} e={e} t={t}")
+    # no binding (direct layer use): static initial range
+    layer = make("insanity", [("lb", "2"), ("ub", "10"),
+                              ("calm_start", "-1"), ("calm_end", "5")])
+    assert layer._range() == (2.0, 10.0)
