@@ -85,7 +85,8 @@ class AttentionLayer(Layer):
         if name == "causal":
             self.causal = int(val)
         if name == "seq_parallel":
-            if val not in ("ring", "ulysses", "none"):
+            from cxxnet_tpu.parallel.ring import SEQ_SCHEMES
+            if val not in SEQ_SCHEMES:
                 raise ValueError(
                     "seq_parallel must be ring, ulysses or none")
             self.seq_parallel = val
@@ -133,16 +134,15 @@ class AttentionLayer(Layer):
         axis; otherwise the fused Pallas flash kernel on TPU, blockwise
         XLA elsewhere."""
         from cxxnet_tpu.ops import pallas_attention as PA
-        from cxxnet_tpu.parallel import ring as R
         from cxxnet_tpu.parallel.mesh import get_active_mesh
+        from cxxnet_tpu.parallel.ring import seq_parallel_attention
         mesh = get_active_mesh()
         causal = bool(self.causal)
-        if (self.seq_parallel != "none" and mesh is not None
-                and R.ring_eligible(mesh, q.shape[2])):
-            if self.seq_parallel == "ulysses":
-                return R.ulysses_attention(q, k, v, mesh, causal=causal,
-                                           kv_block=self.kv_block)
-            return R.ring_attention(q, k, v, mesh, causal=causal)
+        sp = seq_parallel_attention(q, k, v, mesh, self.seq_parallel,
+                                    causal=causal,
+                                    kv_block=self.kv_block)
+        if sp is not None:
+            return sp
         if mesh is not None and mesh.devices.size > 1 \
                 and PA.use_flash_sharded(q, mesh):
             return PA.flash_attention_sharded(q, k, v, mesh, causal)

@@ -24,13 +24,15 @@ things the per-layer config DAG cannot express:
   schedule IS the reverse pipeline (ppermute transposes to the
   opposite rotation), so the same code trains.
 
-The attention core inside the stack is the XLA blockwise kernel
-(ops/attention.py) - per-device and shard_map-safe; ring/Ulysses
-sequence parallelism composes at the single-`attention`-layer level
-(layers/attention.py), not inside the pipelined stack.
+The attention core inside the stack: ring attention when the mesh
+has an eligible 'seq' axis and no pipeline route (scan-over-layers +
+sequence parallelism compose), otherwise the XLA blockwise kernel
+(ops/attention.py) - per-device and shard_map-safe inside the
+pipelined schedule.
 
 Config keys: nlayer, nhead, nhidden (FFN hidden), causal, microbatch,
-kv_block, eps.
+kv_block, eps, seq_parallel (ring | ulysses | none - the non-pipelined
+route's attention-core scheme, shared with the attention layer).
 """
 
 from __future__ import annotations
@@ -63,6 +65,7 @@ class TransformerStackLayer(Layer):
         self.microbatch = 0     # 0 = pipe-axis size
         self.kv_block = 512
         self.eps = 1e-5
+        self.seq_parallel = "ring"
 
     def set_param(self, name: str, val: str) -> None:
         super().set_param(name, val)
@@ -78,6 +81,12 @@ class TransformerStackLayer(Layer):
             self.kv_block = int(val)
         if name == "eps":
             self.eps = float(val)
+        if name == "seq_parallel":
+            from cxxnet_tpu.parallel.ring import SEQ_SCHEMES
+            if val not in SEQ_SCHEMES:
+                raise ValueError(
+                    "seq_parallel must be ring, ulysses or none")
+            self.seq_parallel = val
 
     def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
         self.check_one_to_one(in_shapes)
@@ -128,14 +137,25 @@ class TransformerStackLayer(Layer):
                                  "w2", "b2")}
 
     # ------------------------------------------------------------------
-    def _block(self, bp, x):
+    def _block(self, bp, x, seq_mesh=None):
         """One block; bp leaves have NO leading layer dim; x (b, s, e).
         Norm + QKV plumbing shared with the single-layer family
-        (layers/attention.py helpers)."""
+        (layers/attention.py helpers). With `seq_mesh`, the attention
+        core runs the configured sequence-parallel scheme over its
+        'seq' axis (parallel/ring.py) instead of letting GSPMD
+        all-gather the seq-sharded K/V."""
+        from cxxnet_tpu.parallel.ring import seq_parallel_attention
         h = layer_norm(x, bp["ln1_s"], bp["ln1_b"], self.eps)
         q, k, v = qkv_heads(h, bp["wqkv"], bp["bqkv"], self.nhead)
-        o = blockwise_attention(q, k, v, causal=bool(self.causal),
-                                kv_block=self.kv_block)
+        o = None
+        if seq_mesh is not None:
+            o = seq_parallel_attention(q, k, v, seq_mesh,
+                                       self.seq_parallel,
+                                       causal=bool(self.causal),
+                                       kv_block=self.kv_block)
+        if o is None:
+            o = blockwise_attention(q, k, v, causal=bool(self.causal),
+                                    kv_block=self.kv_block)
         x = x + heads_proj(o, bp["wproj"])
         h2 = layer_norm(x, bp["ln2_s"], bp["ln2_b"], self.eps)
         f = jnp.einsum("bse,he->bsh", h2, bp["w1"].astype(x.dtype))
@@ -143,10 +163,10 @@ class TransformerStackLayer(Layer):
         f = jnp.einsum("bsh,eh->bse", f, bp["w2"].astype(x.dtype))
         return x + f + bp["b2"].astype(x.dtype)[None, None]
 
-    def _scan_blocks(self, params, x):
+    def _scan_blocks(self, params, x, seq_mesh=None):
         """Sequential route: scan over the stacked layer dim."""
         def step(c, bp):
-            return self._block(bp, c), None
+            return self._block(bp, c, seq_mesh), None
         out, _ = lax.scan(step, x, params)
         return out
 
@@ -246,7 +266,10 @@ class TransformerStackLayer(Layer):
         mesh = get_active_mesh()
         P = self._pipe_route(mesh)
         if P:
+            # pipelined: the stages themselves are the sharded dim; the
+            # attention core stays per-device blockwise (a nested 'seq'
+            # shard_map inside the pipe schedule is out of scope)
             out = self._pipelined(params, xs, mesh, P)
         else:
-            out = self._scan_blocks(params, xs)
+            out = self._scan_blocks(params, xs, mesh)
         return [out.reshape(b, 1, s, e)]

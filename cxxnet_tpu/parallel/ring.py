@@ -162,3 +162,21 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
     D] global arrays in, semantics == naive_attention. Requires the
     per-model-shard head count to be divisible by the 'seq' axis size."""
     return _ulysses_jit(q, k, v, mesh, causal, scale, kv_block)
+
+
+SEQ_SCHEMES = ("ring", "ulysses", "none")
+
+
+def seq_parallel_attention(q, k, v, mesh, scheme: str, *,
+                           causal: bool = False, kv_block: int = 512):
+    """Shared sp dispatch for the attention-bearing layers
+    (layers/attention.py, layers/transformer_stack.py): ring or Ulysses
+    over an eligible 'seq' mesh, or None for the caller's per-device
+    fallback (scheme == 'none', no mesh, or ineligible seq length)."""
+    if scheme == "none" or mesh is None or not ring_eligible(
+            mesh, q.shape[2]):
+        return None
+    if scheme == "ulysses":
+        return ulysses_attention(q, k, v, mesh, causal=causal,
+                                 kv_block=kv_block)
+    return ring_attention(q, k, v, mesh, causal=causal)

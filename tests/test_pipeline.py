@@ -114,6 +114,22 @@ def test_pipeline_equals_single_device(mesh, extra):
                                    rtol=3e-4, atol=3e-5)
 
 
+def test_stack_seq_parallel_equals_single_device():
+    """Without a 'pipe' axis, a 'seq' mesh routes the stack's attention
+    cores through ring attention - same trajectory as a single
+    device."""
+    base = _make("")
+    seqp = _make("data:2,seq:2")
+    assert seqp._pshard["ts1"]["wqkv"].spec == ()  # no pipe: replicated
+    for b in _batches():
+        base.update(b)
+        seqp.update(b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(base.state["params"])),
+                    jax.tree.leaves(jax.device_get(seqp.state["params"]))):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-4, atol=3e-5)
+
+
 def test_indivisible_layers_fall_back():
     """nlayer % P != 0 -> sequential route, params replicated."""
     t = _make("pipe:3")
