@@ -159,3 +159,18 @@ def test_stack_training_learns():
     preds = np.concatenate([t.predict(b) for b in batches])
     err = float((preds != label[:, 0]).mean())
     assert err < 0.3, f"stack failed to learn: err={err}"
+
+
+def test_pipeline_with_zero1_equals_single_device():
+    """shard_optimizer=1 composes with the pipe mesh: updater state for
+    the pipe-sharded stack params additionally shards over 'data'
+    (first free divisible dim) and the trajectory is unchanged."""
+    base = _make("")
+    pp = _make("data:2,pipe:2", (("shard_optimizer", "1"),))
+    for b in _batches():
+        base.update(b)
+        pp.update(b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(base.state["params"])),
+                    jax.tree.leaves(jax.device_get(pp.state["params"]))):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-4, atol=3e-5)
