@@ -99,12 +99,18 @@ def test_scan_matches_manual_blocks():
     ("pipe:4", ()),
     ("data:2,pipe:2", ()),
     ("data:2,pipe:2", (("microbatch", "4"),)),
+    ("data:2,pipe:2", (("shard_optimizer", "1"),)),
 ])
 def test_pipeline_equals_single_device(mesh, extra):
     base = _make("")
     pp = _make(mesh, (("microbatch", "0"),) if not extra else extra)
     # stage params really ride the 'pipe' axis
     assert pp._pshard["ts1"]["wqkv"].spec[0] == "pipe"
+    if ("shard_optimizer", "1") in extra:
+        # ZeRO-1 composes: updater state additionally shards over
+        # 'data' on the first free divisible dim
+        assert tuple(pp._ustate_shard["ts1"]["wqkv"].spec)[:2] \
+                == ("pipe", "data")
     for b in _batches():
         base.update(b)
         pp.update(b)
@@ -160,17 +166,3 @@ def test_stack_training_learns():
     err = float((preds != label[:, 0]).mean())
     assert err < 0.3, f"stack failed to learn: err={err}"
 
-
-def test_pipeline_with_zero1_equals_single_device():
-    """shard_optimizer=1 composes with the pipe mesh: updater state for
-    the pipe-sharded stack params additionally shards over 'data'
-    (first free divisible dim) and the trajectory is unchanged."""
-    base = _make("")
-    pp = _make("data:2,pipe:2", (("shard_optimizer", "1"),))
-    for b in _batches():
-        base.update(b)
-        pp.update(b)
-    for a, b in zip(jax.tree.leaves(jax.device_get(base.state["params"])),
-                    jax.tree.leaves(jax.device_get(pp.state["params"]))):
-        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
-                                   rtol=3e-4, atol=3e-5)
