@@ -120,12 +120,13 @@ def test_pipeline_equals_single_device(mesh, extra):
                                    rtol=3e-4, atol=3e-5)
 
 
-def test_stack_seq_parallel_equals_single_device():
+@pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+def test_stack_seq_parallel_equals_single_device(scheme):
     """Without a 'pipe' axis, a 'seq' mesh routes the stack's attention
-    cores through ring attention - same trajectory as a single
-    device."""
+    cores through the configured sp scheme - same trajectory as a
+    single device."""
     base = _make("")
-    seqp = _make("data:2,seq:2")
+    seqp = _make("data:2,seq:2", (("seq_parallel", scheme),))
     assert seqp._pshard["ts1"]["wqkv"].spec == ()  # no pipe: replicated
     for b in _batches():
         base.update(b)

@@ -110,3 +110,34 @@ silent = 1
     assert cnt == 4
     it.before_first()
     assert it.next()
+
+
+def test_wrapper_sequence_model():
+    """The numpy wrapper drives the sequence family end to end."""
+    cfg = """
+netconfig=start
+layer[0->1] = layernorm:ln1
+layer[1->2] = attention:att1
+  nhead = 2
+  causal = 1
+layer[2->3] = flatten
+layer[3->4] = fullc:head
+  nhidden = 4
+layer[4->4] = softmax
+netconfig=end
+input_shape = 1,4,8
+batch_size = 8
+eta = 0.05
+random_type = xavier
+silent = 1
+"""
+    net = Net(dev="cpu", cfg=cfg)
+    net.init_model()
+    rng = np.random.RandomState(2)
+    x = rng.randn(8, 1, 4, 8).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.float32)
+    for _ in range(3):
+        net.update(x, y)
+    pred = net.predict(x)
+    assert pred.shape == (8,)
+    assert np.isfinite(pred).all()
