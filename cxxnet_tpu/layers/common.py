@@ -529,6 +529,7 @@ class BatchNormLayer(Layer):
         self.init_bias = 0.0
         self.eps = 1e-10
         self.global_stats = 0
+        self._conv_node: Optional[bool] = None
 
     def set_param(self, name: str, val: str) -> None:
         super().set_param(name, val)
@@ -541,14 +542,25 @@ class BatchNormLayer(Layer):
         if name == "global_stats":
             self.global_stats = int(val)
 
+    def _is_conv(self, shape) -> bool:
+        """Node kind from the GLOBAL shape recorded at infer_shapes -
+        never from a possibly-sharded local shape: under tensor
+        parallelism a conv activation whose channel dim is sharded down
+        to local size 1 inside shard_map must still normalize per
+        channel over (b, h, w), not as a matrix node."""
+        if self._conv_node is not None:
+            return self._conv_node
+        return shape[1] != 1
+
     def _axes(self, shape: Shape):
         # conv node: stats over (b, h, w) per channel; matrix node: over b
-        if shape[1] != 1:
+        if self._is_conv(shape):
             return (0, 2, 3), (None, slice(None), None, None)
         return (0, 1, 2), (None, None, None, slice(None))
 
     def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
         self.check_one_to_one(in_shapes)
+        self._conv_node = in_shapes[0][1] != 1
         return [in_shapes[0]]
 
     def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
@@ -570,7 +582,7 @@ class BatchNormLayer(Layer):
         mean = jnp.mean(x, axis=axes, keepdims=True)
         var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
         xhat = (x - mean) / jnp.sqrt(var + self.eps)
-        if x.shape[1] != 1:
+        if self._is_conv(x.shape):
             return xhat * slope[None, :, None, None] \
                 + bias[None, :, None, None]
         return xhat * slope[None, None, None, :] \
@@ -589,7 +601,7 @@ class BatchNormLayer(Layer):
             # (mirrors shardings_for's divisibility rule) - under TP the
             # BN then needs NO collectives at all instead of gathering
             # channel-sharded activations
-            cdim = 1 if x.shape[1] != 1 else 3
+            cdim = 1 if self._is_conv(x.shape) else 3
             msize = mesh.shape.get("model", 1)
             axes = [None] * x.ndim
             axes[0] = "data"

@@ -238,9 +238,12 @@ class NetTrainer:
             "accum": accum,
             "count": jnp.zeros((), jnp.int32),
             "epoch": jnp.asarray(self.epoch, jnp.int32),
-            # on-device train-metric accumulator: one (sum, count) row per
-            # configured metric (utils/metric_jit.py)
-            "tmetric": jnp.zeros((len(self.train_metric), 2), jnp.float32),
+            # on-device train-metric accumulator: one (sum, comp,
+            # count) row per configured metric; `comp` is the Kahan
+            # compensation term so a long round's f32 sum doesn't
+            # drift (the eval path avoids this with per-batch host f64
+            # reduction; the train path cannot read back per step)
+            "tmetric": jnp.zeros((len(self.train_metric), 3), jnp.float32),
         }
         if self._loaded_opt is not None:
             state["ustate"] = jax.tree.map(
@@ -395,8 +398,14 @@ class NetTrainer:
                 (state["params"], state["ustate"], accum))
             tmetric = state["tmetric"]
             if eval_train:
-                tmetric = tmetric + metric_rows(outs, labels, mask, rng,
-                                                1000)
+                rows = metric_rows(outs, labels, mask, rng, 1000)
+                # Kahan-compensated sum in column 0; plain count in 2
+                s, comp, cnt = (tmetric[:, 0], tmetric[:, 1],
+                                tmetric[:, 2])
+                y = rows[:, 0] - comp
+                t = s + y
+                tmetric = jnp.stack(
+                    [t, (t - s) - y, cnt + rows[:, 1]], axis=1)
             new_state = {
                 "params": params,
                 "ustate": ustate,
@@ -637,6 +646,13 @@ class NetTrainer:
                      for k, v in labels.items()},
                     distributed.put_global(mask.astype(np.float32), shd),
                     rng))
+                if step % 8 == 0:
+                    # bound in-flight work: without a periodic sync the
+                    # host loop stages the whole dataset's input
+                    # buffers ahead of the device (HBM blow-up on large
+                    # eval sets); syncing on the tiny metric rows keeps
+                    # <=8 batches of inputs pinned
+                    jax.block_until_ready(per_batch[-1])
             # host-side float64 reduction across batches (the host
             # MetricSet path accumulated in f64; per-batch f32 sums are
             # exact at batch scale, the cross-batch sum is not)
@@ -664,7 +680,9 @@ class NetTrainer:
         from cxxnet_tpu.utils import metric_jit
         specs = self.train_metric.specs
         if specs and self.state is not None:
-            vals = distributed.fetch_local(self.state["tmetric"])
+            acc = distributed.fetch_local(self.state["tmetric"])
+            # resolve the Kahan pair: true sum ~= sum - comp
+            vals = np.stack([acc[:, 0] - acc[:, 1], acc[:, 2]], axis=1)
             out = metric_jit.format_metrics("train", specs, vals)
             self.clear_train_metric()
             return out
@@ -678,7 +696,7 @@ class NetTrainer:
         if self.state is not None and "tmetric" in self.state:
             n = len(self.train_metric)
             self.state["tmetric"] = distributed.put_global(
-                np.zeros((n, 2), np.float32), self._replicated)
+                np.zeros((n, 3), np.float32), self._replicated)
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """Prediction = argmax of the final node (or raw scalar);
@@ -822,7 +840,10 @@ class NetTrainer:
         cur = self.state["params"][lk[0]][lk[1]]
         arr = np.asarray(weight, dtype=np.float32).reshape(cur.shape)
         params = self.state["params"]
-        params[lk[0]][lk[1]] = distributed.put_global(
+        # full global host value -> put_global_full (put_global would
+        # misread it as a pre-cut local shard when the param is sharded
+        # across processes, e.g. tensor parallelism over hosts)
+        params[lk[0]][lk[1]] = distributed.put_global_full(
             arr, self._pshard[lk[0]][lk[1]])
         self.state["params"] = params
 

@@ -133,3 +133,35 @@ def test_tp_checkpoint_roundtrip(tmp_path):
     b = jax.tree.map(np.asarray, dp.state["params"])
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(x, y, rtol=1e-6)
+
+
+def test_batch_norm_channels_sharded_to_one():
+    """Regression: a conv-node batch_norm whose channel count EQUALS
+    the model-axis size (local C=1 inside shard_map) must still
+    normalize per channel over (b, h, w) - the node kind comes from the
+    global shape at infer_shapes, never the sharded local shape."""
+    from cxxnet_tpu.layers import create_layer
+    from cxxnet_tpu.parallel.mesh import active_mesh
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    bn = create_layer("batch_norm")
+    shape = (8, 4, 3, 3)             # C=4 == model-axis size
+    bn.infer_shapes([shape])
+    params = bn.init_params(jax.random.PRNGKey(0), [shape])
+    x = np.random.RandomState(0).randn(*shape).astype(np.float32)
+
+    # reference: per-data-shard stats, full channels per shard
+    ref_halves = []
+    for half in (x[:4], x[4:]):
+        m = half.mean(axis=(0, 2, 3), keepdims=True)
+        v = ((half - m) ** 2).mean(axis=(0, 2, 3), keepdims=True)
+        ref_halves.append((half - m) / np.sqrt(v + bn.eps))
+    ref = np.concatenate(ref_halves)
+
+    with active_mesh(mesh):
+        (out,) = jax.jit(
+            lambda p, xx: bn.apply(p, [xx], train=True))(params, x)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                               atol=1e-5)

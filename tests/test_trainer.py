@@ -348,8 +348,8 @@ def test_train_metric_ignores_padded_rows():
     y = np.ones((10, 1), np.float32)
     t.update(DataBatch(data=x, label=y))  # padded 10 -> 16
     vals = np.asarray(t.state["tmetric"])
-    assert vals.shape == (1, 2)
-    assert vals[0, 1] == 10.0  # count == valid rows only
+    assert vals.shape == (1, 3)  # (sum, kahan comp, count)
+    assert vals[0, 2] == 10.0  # count == valid rows only
 
 
 def test_multi_target_metrics():
