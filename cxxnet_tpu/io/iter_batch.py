@@ -144,6 +144,10 @@ class ThreadBufferIterator(DataIter):
             while not stop.is_set() and self.base.next():
                 if not stoppable_put(q, stop, self.base.value()):
                     return
+        except BaseException as e:  # noqa: BLE001 - re-raised in next()
+            # a producer failure must surface in the consumer, not
+            # masquerade as a clean end-of-data
+            self._exc = e
         finally:
             stoppable_put(q, stop, None)
 
@@ -151,6 +155,8 @@ class ThreadBufferIterator(DataIter):
         self._shutdown()
         self._stop = threading.Event()
         self._q = queue.Queue(maxsize=self.buffer_size)
+        self._exc = None
+        self._done = False
         self._thread = threading.Thread(
             target=self._producer, args=(self._q, self._stop), daemon=True)
         self._thread.start()
@@ -163,8 +169,18 @@ class ThreadBufferIterator(DataIter):
     def next(self) -> bool:
         if self._q is None:
             self.before_first()
+        if self._done:
+            # reference ThreadBuffer keeps returning false after EOF;
+            # blocking on the dead producer's empty queue would hang
+            return False
         item = self._q.get()
         if item is None:
+            self._done = True
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise RuntimeError(
+                    "ThreadBufferIterator: producer thread failed") \
+                    from exc
             return False
         self._out = item
         return True

@@ -195,7 +195,12 @@ class NetConfig:
             elif inc == 0:
                 info.nindex_out.append(top_node)
             else:
-                tag = f"!node-after-{top_node}"
+                # key anonymous nodes by the LAYER index, not the top
+                # node: two `layer[+1]` declarations whose top is the
+                # same node (after an explicit re-target) must allocate
+                # distinct output nodes, as the reference's positional
+                # allocation does
+                tag = f"!node-of-layer-{cfg_layer_index}"
                 info.nindex_out.append(self.get_node_index(tag, True))
         else:
             m = re.match(r"^layer\[([^\]>]+)->([^\]]+)\]$", name)

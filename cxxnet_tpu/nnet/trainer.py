@@ -348,11 +348,14 @@ class NetTrainer:
         from cxxnet_tpu.layers.base import active_step
         from cxxnet_tpu.parallel.mesh import active_mesh
 
-        def loss_fn(params, data, labels, mask, rng, step):
+        def loss_fn(params, data, extras, labels, mask, rng, step):
             cparams = self._cast(params)
+            inputs = {0: self._cast(data)}
+            for i, e in enumerate(extras):
+                inputs[1 + i] = self._cast(e)
             with active_mesh(self.mesh), active_step(step):
                 values, loss = net.forward(
-                    cparams, {0: self._cast(data)}, train=True, rng=rng,
+                    cparams, inputs, train=True, rng=rng,
                     labels=labels, mask=mask)
             outs = {nid: values[nid].astype(jnp.float32)
                     for nid in eval_node_ids}
@@ -367,13 +370,13 @@ class NetTrainer:
             # scratch the same way)
             loss_fn = jax.checkpoint(loss_fn)
 
-        def train_step(state, data, labels, mask, rng):
+        def train_step(state, data, extras, labels, mask, rng):
             # per-forward training-step counter (updates so far) for
             # step-dependent layers (insanity anneal)
             step = state["epoch"] * update_period + state["count"]
             (loss, outs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state["params"], data, labels, mask,
-                                       rng, step)
+                loss_fn, has_aux=True)(state["params"], data, extras,
+                                       labels, mask, rng, step)
             accum = jax.tree.map(jnp.add, state["accum"], grads)
             count = state["count"] + 1
             do_update = count >= update_period
@@ -416,23 +419,25 @@ class NetTrainer:
             }
             return new_state, loss
 
-        def eval_step(params, data):
+        def eval_step(params, data, extras):
             cparams = self._cast(params)
+            inputs = {0: self._cast(data)}
+            for i, e in enumerate(extras):
+                inputs[1 + i] = self._cast(e)
             with active_mesh(self.mesh):
-                values, _ = net.forward(cparams, {0: self._cast(data)},
-                                        train=False)
+                values, _ = net.forward(cparams, inputs, train=False)
             return {nid: values[nid].astype(jnp.float32)
                     for nid in range(net.cfg.num_nodes)
                     if values[nid] is not None}
 
-        def eval_metric_step(params, data, labels, mask, rng):
+        def eval_metric_step(params, data, extras, labels, mask, rng):
             """Forward + per-batch metric rows fully on device: the eval
             loop keeps the tiny (n_metrics, 2) results and sums them on
             the host in float64 after the dataset - no per-batch
             readback of node outputs (nnet_impl-inl.hpp:224-245 does
             that on the host every batch) and no cross-batch f32
             accumulation drift."""
-            outs = eval_step(params, data)
+            outs = eval_step(params, data, extras)
             return metric_rows(outs, labels, mask, rng, 2000)
 
         rep, shd = self._replicated, self._batch_sharded
@@ -458,21 +463,22 @@ class NetTrainer:
         self._state_shardings = state_shardings
         label_shardings = {
             f: shd for f in self.net_cfg.label_name_map}
+        eshd = (shd,) * self.net_cfg.extra_data_num
         self._train_step = jax.jit(
             train_step,
-            in_shardings=(state_shardings, dshd, label_shardings, shd,
-                          rep),
+            in_shardings=(state_shardings, dshd, eshd, label_shardings,
+                          shd, rep),
             out_shardings=(state_shardings, rep),
             donate_argnums=(0,))
         self._eval_step = jax.jit(
-            eval_step, in_shardings=(self._pshard, dshd),
+            eval_step, in_shardings=(self._pshard, dshd, eshd),
             out_shardings=shd)
         self._eval_metric_step = None
         if metric_specs:
             self._eval_metric_step = jax.jit(
                 eval_metric_step,
-                in_shardings=(self._pshard, dshd, label_shardings, shd,
-                              rep),
+                in_shardings=(self._pshard, dshd, eshd, label_shardings,
+                              shd, rep),
                 out_shardings=rep)
 
     # ------------------------------------------------------------------
@@ -538,44 +544,66 @@ class NetTrainer:
             self._host_input(data), self._data_sharded, gshape,
             self._local_row_start)
 
-    def _pad_batch(self, batch: DataBatch):
+    def _pad_batch(self, batch: DataBatch, train: bool = False):
         """Pad a short batch up to the local batch (static shapes).
 
         Sparse CSR batches (data.h:96-181) densify to the net input
-        shape first - the jitted step consumes static dense tensors."""
+        shape first - the jitted step consumes static dense tensors.
+
+        `train`: every DELIVERED row is valid. num_batch_padd marks
+        round_batch wrap-fill rows, which are REAL instances consumed
+        early from the next epoch - the reference trains them and trims
+        them only from eval/pred (nnet_impl-inl.hpp:239); masking them
+        in training would mean they are never trained at all (the
+        iterator deliberately does not re-serve them). Eval paths keep
+        the trimming mask.
+
+        Returns (data, label, mask, extras) where extras are the padded
+        extra-data arrays feeding input nodes 1..k (network.py)."""
         b = batch.batch_size
         if batch.is_sparse():
             c, y, x = self.net_cfg.input_shape
             batch = DataBatch(
                 data=batch.to_dense(c * y * x).reshape(b, c, y, x),
                 label=batch.label, inst_index=batch.inst_index,
-                num_batch_padd=batch.num_batch_padd)
+                num_batch_padd=batch.num_batch_padd,
+                extra_data=batch.extra_data)
+        n_extra = self.net_cfg.extra_data_num
+        extras = list(batch.extra_data[:n_extra])
+        if len(extras) < n_extra:
+            raise ValueError(
+                f"net declares extra_data_num={n_extra} but the batch "
+                f"carries {len(extras)} extra arrays (use attachtxt or "
+                "fill DataBatch.extra_data)")
+        valid = np.ones(b, np.float32) if train else batch.valid_mask()
         if b == self._local_batch:
-            return batch.data, batch.label, batch.valid_mask()
+            return batch.data, batch.label, valid, tuple(
+                np.asarray(e, np.float32) for e in extras)
         if b > self._local_batch:
             raise ValueError("batch larger than configured batch_size")
         pad = self._local_batch - b
-        data = np.concatenate(
-            [batch.data, np.zeros((pad,) + batch.data.shape[1:],
-                                  batch.data.dtype)], axis=0)
-        label = np.concatenate(
-            [batch.label, np.zeros((pad,) + batch.label.shape[1:],
-                                   batch.label.dtype)], axis=0)
-        mask = np.concatenate([batch.valid_mask(),
-                               np.zeros(pad, np.float32)])
-        return data, label, mask
+
+        def padrows(a):
+            a = np.asarray(a)
+            return np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+
+        mask = np.concatenate([valid, np.zeros(pad, np.float32)])
+        return (padrows(batch.data), padrows(batch.label), mask,
+                tuple(padrows(e).astype(np.float32) for e in extras))
 
     def update(self, batch: DataBatch) -> None:
         """One training mini-batch (CXXNetThreadTrainer::Update)."""
         import time as _time
         t0 = _time.perf_counter() if self.profile else 0.0
-        data, label, mask = self._pad_batch(batch)
+        data, label, mask, extras = self._pad_batch(batch, train=True)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.seed + 100), self._step_counter)
         self._step_counter += 1
         labels = self._label_fields(label.astype(np.float32))
         shd = self._batch_sharded
         gdata = self._put_data(data)
+        gextras = tuple(distributed.put_global(e, shd) for e in extras)
         glabels = {k: distributed.put_global(v, shd)
                    for k, v in labels.items()}
         gmask = distributed.put_global(mask.astype(np.float32), shd)
@@ -590,7 +618,7 @@ class NetTrainer:
         # accumulate on device - nothing here blocks on the result, so
         # host-side input prep for batch k+1 overlaps compute of batch k
         self.state, loss = self._train_step(
-            self.state, gdata, glabels, gmask, rng)
+            self.state, gdata, gextras, glabels, gmask, rng)
         # host mirror of the device epoch counter (one update per
         # update_period steps) - avoids forcing a device sync per step
         self.epoch = self._epoch_base + (self._step_counter
@@ -612,9 +640,11 @@ class NetTrainer:
     # evaluation / inference api
     # ------------------------------------------------------------------
     def _forward_nodes(self, batch: DataBatch) -> Dict[int, np.ndarray]:
-        data, _, mask = self._pad_batch(batch)
+        data, _, mask, extras = self._pad_batch(batch)
         gdata = self._put_data(data)
-        outs = self._eval_step(self.state["params"], gdata)
+        shd = self._batch_sharded
+        gextras = tuple(distributed.put_global(e, shd) for e in extras)
+        outs = self._eval_step(self.state["params"], gdata, gextras)
         valid = int(mask.sum())
         return {nid: distributed.fetch_local(v)[:valid]
                 for nid, v in outs.items()}
@@ -634,7 +664,7 @@ class NetTrainer:
             step = 0
             while data_iter.next():
                 batch = data_iter.value()
-                data, label, mask = self._pad_batch(batch)
+                data, label, mask, extras = self._pad_batch(batch)
                 rng = jax.random.fold_in(
                     jax.random.PRNGKey(self.seed + 200), step)
                 step += 1
@@ -642,6 +672,8 @@ class NetTrainer:
                 per_batch.append(self._eval_metric_step(
                     self.state["params"],
                     self._put_data(data),
+                    tuple(distributed.put_global(e, shd)
+                          for e in extras),
                     {k: distributed.put_global(v, shd)
                      for k, v in labels.items()},
                     distributed.put_global(mask.astype(np.float32), shd),

@@ -169,10 +169,17 @@ class Pipeline {
   }
 
   void Stop() {
-    stop_.store(true);
-    cv_task_.notify_all();
-    cv_done_.notify_all();
-    cv_space_.notify_all();
+    {
+      // the stop flag and the notifies must be published under the
+      // mutex: a waiter that has evaluated its predicate (stop_ ==
+      // false) but not yet blocked would otherwise miss the wakeup
+      // forever and hang the joins below
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_.store(true);
+      cv_task_.notify_all();
+      cv_done_.notify_all();
+      cv_space_.notify_all();
+    }
     if (reader_.joinable()) reader_.join();
     for (auto& t : workers_)
       if (t.joinable()) t.join();
@@ -229,14 +236,15 @@ class Pipeline {
         }
         const int32_t* ints = reinterpret_cast<const int32_t*>(page.data());
         int32_t n = ints[0];
-        if (n < 0 || n + 2 > kPageNumInts) {
+        if (n < 0 ||
+            static_cast<int64_t>(n) + 2 > static_cast<int64_t>(kPageNumInts)) {
           std::fclose(f);
           Fail("corrupt page header in " + path);
           return;
         }
         for (int32_t r = 0; r < n && !stop_.load(); ++r) {
           int64_t start = ints[r + 1], end = ints[r + 2];
-          if (end < start || end > kPageSize) {
+          if (start < 0 || end < start || end > kPageSize) {
             std::fclose(f);
             Fail("corrupt blob offsets in " + path);
             return;

@@ -241,3 +241,28 @@ def test_param_key_naming():
     assert param_key(cfg, 0) == "fc1"
     assert param_key(cfg, 1) == "se1"
     assert param_key(cfg, 3) == "layer_3"
+
+
+def test_anonymous_nodes_unique_after_retarget():
+    """Two layer[+1] declarations whose top is the same node (after an
+    explicit re-target) must allocate DISTINCT anonymous output nodes -
+    the reference allocates positionally (regression: name-keyed
+    anonymous nodes aliased)."""
+    cfg = NetConfig()
+    cfg.configure(parse_config_string("""
+netconfig=start
+layer[0->b] = fullc:f1
+  nhidden = 4
+layer[+1] = relu
+layer[!node-of-layer-1->b2] = fullc:f2
+  nhidden = 4
+layer[b2->b] = fullc:f3
+  nhidden = 4
+layer[+1] = sigmoid
+netconfig=end
+input_shape = 1,1,4
+batch_size = 2
+"""))
+    relu_out = cfg.layers[1].nindex_out[0]
+    sig_out = cfg.layers[4].nindex_out[0]
+    assert relu_out != sig_out, (relu_out, sig_out)

@@ -88,7 +88,7 @@ def test_pipeline_step_keeps_stage_params_sharded():
     labels = {"label": np.zeros((8, 1), np.float32)}
     mask = np.ones(8, np.float32)
     hlo = t._train_step.lower(
-        t.state, data, labels, mask,
+        t.state, data, (), labels, mask,
         jax.random.PRNGKey(0)).compile().as_text()
     assert _count(hlo, "collective-permute") >= 1, "no pipeline flow"
     stack_elems = sum(int(np.prod(p.shape))

@@ -61,7 +61,7 @@ def test_dp_allreduce_volume_equals_grad_bytes():
     labels = {"label": np.zeros((16, 1), np.float32)}
     mask = np.ones(16, np.float32)
     hlo = t._train_step.lower(
-        t.state, data, labels, mask,
+        t.state, data, (), labels, mask,
         jax.random.PRNGKey(0)).compile().as_text()
 
     ar_lines = [l for l in hlo.splitlines()

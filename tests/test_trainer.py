@@ -455,3 +455,72 @@ metric = rmse
     # (step+1)^2 = 1, 4, 9 for steps 0, 1, 2 - spanning the
     # update_period=2 epoch boundary
     np.testing.assert_allclose(seen, [1.0, 4.0, 9.0], rtol=1e-5)
+
+
+def test_extra_data_nodes_feed_through():
+    """extra_data_num nets train and predict end to end: the trainer
+    feeds DataBatch.extra_data into input nodes in_1.. (the attachtxt
+    pipeline's consumer side - data.h:96-139)."""
+    cfg = """
+extra_data_num = 1
+extra_data_shape[0] = 1,1,4
+netconfig=start
+layer[in,in_1->2] = concat
+layer[2->3] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[3->4] = relu
+layer[4->5] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[5->5] = softmax
+netconfig=end
+input_shape = 1,1,4
+batch_size = 8
+eta = 0.2
+momentum = 0.9
+metric = error
+silent = 1
+"""
+    t = NetTrainer()
+    for k, v in parse_config_string(cfg):
+        t.set_param(k, v)
+    t.init_model()
+    rng = np.random.RandomState(6)
+    # the label depends ONLY on the extra-data input: training can only
+    # succeed if in_1 is actually fed
+    for _ in range(30):
+        x = rng.randn(8, 1, 1, 4).astype(np.float32)
+        e = rng.randn(8, 1, 1, 4).astype(np.float32)
+        y = (e.reshape(8, 4).sum(1) > 0).astype(np.float32)
+        t.update(DataBatch(data=x, label=y.reshape(8, 1),
+                           extra_data=[e]))
+    x = rng.randn(8, 1, 1, 4).astype(np.float32)
+    e = rng.randn(8, 1, 1, 4).astype(np.float32)
+    y = (e.reshape(8, 4).sum(1) > 0).astype(np.float32)
+    pred = t.predict(DataBatch(data=x, label=y.reshape(8, 1),
+                               extra_data=[e]))
+    assert (pred == y).mean() >= 0.75, (pred, y)
+    # missing extras must fail loudly, not silently feed garbage
+    with pytest.raises(ValueError, match="extra_data_num"):
+        t.update(DataBatch(data=x, label=y.reshape(8, 1)))
+
+
+def test_round_batch_wrap_rows_are_trained():
+    """round_batch wrap-fill rows are REAL instances consumed early
+    from the next epoch; training must include them (the reference
+    trims num_batch_padd only at eval - nnet_impl-inl.hpp:239)."""
+    t = make_trainer()
+    x = np.random.RandomState(1).randn(16, 1, 1, 8).astype(np.float32)
+    y = np.zeros((16, 1), np.float32)
+    wrapped = DataBatch(data=x, label=y, num_batch_padd=6)
+    p0 = np.asarray(t.state["params"]["fc1"]["wmat"]).copy()
+    t.update(wrapped)
+    # train metric counted ALL 16 rows (not 10)
+    vals = np.asarray(t.state["tmetric"])
+    assert vals[0, 2] == 16.0, vals
+    # but eval still trims the wrap rows
+    out = t.evaluate(ListIter([wrapped]), "e")
+    assert np.isfinite(float(out.split(":")[-1]))
+    assert np.abs(np.asarray(t.state["params"]["fc1"]["wmat"])
+                  - p0).max() > 0
