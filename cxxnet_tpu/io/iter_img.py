@@ -66,6 +66,8 @@ class ImageIterator(DataIter):
         self.shuffle = 0
         self.silent = 0
         self.label_width = 1
+        self.dist_num_worker = 1
+        self.dist_worker_rank = 0
         self.rng = np.random.RandomState(self.K_RAND_MAGIC)
         self.order: List[int] = []
         self.loc = 0
@@ -83,9 +85,20 @@ class ImageIterator(DataIter):
             self.label_width = int(val)
         if name == "seed_data":
             self.rng = np.random.RandomState(self.K_RAND_MAGIC + int(val))
+        if name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        if name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
 
     def init(self) -> None:
-        self.entries = parse_list_file(self.path_imglist)
+        from cxxnet_tpu.io.iterators import shard_quota
+        entries = parse_list_file(self.path_imglist)
+        nw = self.dist_num_worker
+        if nw > 1:
+            quota, rank = shard_quota(len(entries), nw,
+                                      self.dist_worker_rank)
+            entries = entries[rank::nw][:quota]
+        self.entries = entries
         self.order = list(range(len(self.entries)))
         if not self.silent:
             print(f"ImageIterator: {self.path_imglist}, "
@@ -120,6 +133,7 @@ class _PageReader(threading.Thread):
         self.paths = paths
         self.out_q = out_q
         self.stop_event = stop
+        self.exc = None
 
     def _put(self, item) -> bool:
         return stoppable_put(self.out_q, self.stop_event, item)
@@ -131,6 +145,8 @@ class _PageReader(threading.Thread):
                     for blobs in iter_page_blobs(f):
                         if not self._put(blobs):
                             return
+        except BaseException as e:  # noqa: BLE001 - re-raised by consumer
+            self.exc = e
         finally:
             self._put(None)  # sentinel
 
@@ -223,6 +239,19 @@ class ImageBinIterator(DataIter):
         if self.shuffle and self.shuffle_buffer < 1:
             raise ValueError("shuffle=1 requires shuffle_buffer >= 1")
         self._native_mode = (self.use_native != 0 and native_available())
+        # without conf_prefix file-sharding, multi-worker runs shard at
+        # the INSTANCE level (ordinal % nw == rank, quota-trimmed so
+        # every worker serves the same count - unequal batch counts
+        # would desynchronize the per-batch SPMD collectives); with
+        # conf_prefix, files are round-robin sharded above instead
+        self._shard_nw = (self.dist_num_worker
+                          if (self.dist_num_worker > 1
+                              and not self.conf_prefix) else 1)
+        self._shard_quota = 0
+        if self._shard_nw > 1:
+            from cxxnet_tpu.io.iterators import shard_quota
+            self._shard_quota, _ = shard_quota(
+                len(self.entries), self._shard_nw, self.dist_worker_rank)
         if not self.silent:
             mode = "native" if self._native_mode else "python"
             print(f"ImageBinIterator: {len(self.entries)} images from "
@@ -230,6 +259,7 @@ class ImageBinIterator(DataIter):
         self.before_first()
 
     def before_first(self) -> None:
+        self._served = 0
         if self._native_mode:
             from cxxnet_tpu.io.native import NativeBinReader
             if self._native is None:
@@ -266,6 +296,11 @@ class ImageBinIterator(DataIter):
     def _next_page(self) -> bool:
         blobs = self._q.get()
         if blobs is None:
+            exc = getattr(self._reader, "exc", None)
+            if exc is not None:
+                self._reader.exc = None
+                raise RuntimeError(
+                    "imgbin page reader failed") from exc
             return False
         self._page_objs = blobs
         self._page_order = list(range(len(self._page_objs)))
@@ -289,18 +324,32 @@ class ImageBinIterator(DataIter):
         while (self._submit_pos < len(self._page_order)
                and self._submit_pos - self._page_pos < ahead):
             j = self._page_order[self._submit_pos]
-            self._futures[self._submit_pos] = self._pool.submit(
-                decode_image, self._page_objs[j])
+            ent_idx = self._entry_pos + j
+            if (self._shard_nw <= 1
+                    or ent_idx % self._shard_nw == self.dist_worker_rank):
+                # non-owned instances are skipped by next(); don't burn
+                # the decode pool on them
+                self._futures[self._submit_pos] = self._pool.submit(
+                    decode_image, self._page_objs[j])
             self._submit_pos += 1
 
     def _pull_native(self) -> Optional[DataInst]:
-        data = self._native.next()
-        if data is None:
-            return None
-        idx, labels, _ = self.entries[self._nseq]
-        self._nseq += 1
-        label = np.asarray(labels[:self.label_width], dtype=np.float32)
-        return DataInst(index=idx, data=data, label=label)
+        while True:
+            if self._shard_nw > 1 and self._served >= self._shard_quota:
+                return None
+            data = self._native.next()
+            if data is None:
+                return None
+            ordinal = self._nseq
+            self._nseq += 1
+            if self._shard_nw > 1:
+                if ordinal % self._shard_nw != self.dist_worker_rank:
+                    continue
+                self._served += 1
+            idx, labels, _ = self.entries[ordinal]
+            label = np.asarray(labels[:self.label_width],
+                               dtype=np.float32)
+            return DataInst(index=idx, data=data, label=label)
 
     def _next_native(self) -> bool:
         """Native stream is strictly ordered; shuffle uses a bounded
@@ -336,23 +385,37 @@ class ImageBinIterator(DataIter):
     def next(self) -> bool:
         if self._native_mode:
             return self._next_native()
-        while self._page_pos >= len(self._page_objs):
-            if not self._next_page():
-                return False
-        k = self._page_pos
-        ent_idx = self._entry_pos + self._page_order[k]
-        self._page_pos += 1
-        if k in self._futures:
-            data = self._futures.pop(k).result()
-        else:
-            data = decode_image(self._page_objs[self._page_order[k]])
-        self._fill_decode_window()
-        if self._page_pos >= len(self._page_objs):
-            self._entry_pos += len(self._page_objs)
-        idx, labels, _ = self.entries[ent_idx]
-        label = np.asarray(labels[:self.label_width], dtype=np.float32)
-        self._out = DataInst(index=idx, data=data, label=label)
-        return True
+        while True:
+            while self._page_pos >= len(self._page_objs):
+                if not self._next_page():
+                    return False
+            k = self._page_pos
+            ent_idx = self._entry_pos + self._page_order[k]
+            self._page_pos += 1
+            owned = True
+            if self._shard_nw > 1:
+                if self._served >= self._shard_quota:
+                    return False
+                owned = (ent_idx % self._shard_nw
+                         == self.dist_worker_rank)
+            if owned and k in self._futures:
+                data = self._futures.pop(k).result()
+            elif owned:
+                data = decode_image(self._page_objs[self._page_order[k]])
+            else:
+                self._futures.pop(k, None)
+            self._fill_decode_window()
+            if self._page_pos >= len(self._page_objs):
+                self._entry_pos += len(self._page_objs)
+            if not owned:
+                continue
+            if self._shard_nw > 1:
+                self._served += 1
+            idx, labels, _ = self.entries[ent_idx]
+            label = np.asarray(labels[:self.label_width],
+                               dtype=np.float32)
+            self._out = DataInst(index=idx, data=data, label=label)
+            return True
 
     def value(self) -> DataInst:
         return self._out

@@ -73,10 +73,11 @@ class MNISTIterator(DataIter):
         inst = np.arange(len(labels), dtype=np.uint32) + self.inst_offset
         nw = getattr(self, "dist_num_worker", 1)
         if nw > 1:
-            # per-worker shard (reference sharding discipline,
-            # iter_thread_imbin-inl.hpp:189-220)
-            r = getattr(self, "dist_worker_rank", 0)
-            img, labels, inst = img[r::nw], labels[r::nw], inst[r::nw]
+            from cxxnet_tpu.io.iterators import shard_quota
+            quota, r = shard_quota(len(labels), nw,
+                                   getattr(self, "dist_worker_rank", 0))
+            img, labels, inst = (img[r::nw][:quota], labels[r::nw][:quota],
+                                 inst[r::nw][:quota])
         if self.shuffle:
             rng = np.random.RandomState(self.seed)
             order = rng.permutation(len(labels))

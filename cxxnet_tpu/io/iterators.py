@@ -30,3 +30,23 @@ class DataIter(Generic[T]):
         self.before_first()
         while self.next():
             yield self.value()
+
+
+def shard_quota(n: int, num_worker: int, rank: int):
+    """Equalized per-worker shard accounting shared by the base
+    iterators (reference discipline iter_thread_imbin-inl.hpp:189-220,
+    tightened for sync SPMD): every worker must serve EXACTLY
+    floor(n/num_worker) instances - unequal per-worker batch counts
+    would desynchronize the per-batch collectives. A dataset smaller
+    than the worker count cannot satisfy that and fails loudly.
+
+    Returns (quota, rank). Callers either slice `rows[rank::nw][:quota]`
+    or filter ordinals `ord % nw == rank` counting served up to quota.
+    """
+    if num_worker <= 1:
+        return n, 0
+    if n < num_worker:
+        raise ValueError(
+            f"dataset of {n} instances cannot shard over "
+            f"{num_worker} workers (fewer instances than workers)")
+    return n // num_worker, rank

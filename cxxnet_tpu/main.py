@@ -376,6 +376,11 @@ class LearnTask:
                         fo.write(" ".join(f"{v:g}" for v in row) + "\n")
                 else:
                     flat.astype("float32").tofile(fo)
+        if dshape is None:
+            os.remove(self.name_pred)  # no stale empty artifact
+            raise ValueError(
+                "task=extract: the pred iterator yielded no data "
+                "(empty list file or dataset smaller than one batch)")
         with open(self.name_pred + ".meta", "w") as fm:
             fm.write(f"{nrow},{dshape[0]},{dshape[1]},{dshape[2]}\n")
         print(f"finished prediction, write into {self.name_pred}")

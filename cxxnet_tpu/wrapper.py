@@ -151,7 +151,14 @@ class Net:
 def train(cfg: str, data, label, num_round: int,
           param, eval_data=None, batch_size: int = 128,
           dev: str = "cpu") -> Net:
-    """Convenience trainer over numpy arrays (cxxnet.py:301-312)."""
+    """Convenience trainer over numpy arrays (cxxnet.py:301-312).
+
+    eval_data: optional (data, label) pair; CLASSIFICATION error is
+    computed after every round (batch_size chunks) and printed to
+    stderr like the CLI round loop - regression nets should evaluate
+    manually. The final partial batch of each round trains too (padded
+    internally)."""
+    import sys as _sys
     net = Net(dev=dev, cfg=cfg)
     net.set_param("batch_size", batch_size)
     for k, v in (param.items() if isinstance(param, dict) else param):
@@ -160,6 +167,13 @@ def train(cfg: str, data, label, num_round: int,
     n = data.shape[0]
     for r in range(num_round):
         net.start_round(r)
-        for i in range(0, n - batch_size + 1, batch_size):
+        for i in range(0, n, batch_size):
             net.update(data[i:i + batch_size], label[i:i + batch_size])
+        if eval_data is not None:
+            ed, el = eval_data
+            preds = [net.predict(ed[i:i + batch_size])
+                     for i in range(0, ed.shape[0], batch_size)]
+            pred = np.concatenate(preds)
+            err = float((pred != np.asarray(el).reshape(-1)).mean())
+            _sys.stderr.write(f"[{r}]\teval-error:{err:g}\n")
     return net

@@ -26,7 +26,8 @@ thread_local std::string tls_error;  // CXNGetLastError storage
 thread_local std::string tls_str;    // CXNNetEvaluate return storage
 
 void InitPython() {
-  if (!Py_IsInitialized()) {
+  const bool we_initialized = !Py_IsInitialized();
+  if (we_initialized) {
     Py_InitializeEx(0);
   }
   PyGILState_STATE st = PyGILState_Ensure();
@@ -39,9 +40,12 @@ void InitPython() {
   }
   g_capi = mod;  // leaked on purpose: lives for the process
   PyGILState_Release(st);
-  // release the GIL acquired by Py_InitializeEx on this thread so other
-  // threads (and later PyGILState_Ensure calls) can take it
-  if (PyGILState_Check()) {
+  // release the GIL acquired by Py_InitializeEx on this thread so
+  // other threads (and later PyGILState_Ensure calls) can take it.
+  // ONLY when this library did the initialization: in a host process
+  // that already runs Python (ctypes.PyDLL / a C extension), the GIL
+  // we would be releasing belongs to the CALLER.
+  if (we_initialized && PyGILState_Check()) {
     PyEval_SaveThread();
   }
 }

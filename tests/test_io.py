@@ -456,3 +456,14 @@ silent = 1
     np.testing.assert_allclose(batches[0].label, first)
     # consecutive epochs identical
     assert len(list(it)) == 3
+
+
+def test_shard_quota_equalizes_and_rejects_tiny():
+    """Per-worker shard accounting: equal counts always; a dataset
+    smaller than the worker count fails loudly (silently serving zero
+    or unequal rows would desynchronize the SPMD collectives)."""
+    from cxxnet_tpu.io.iterators import shard_quota
+    assert shard_quota(10, 1, 0) == (10, 0)
+    assert shard_quota(10, 3, 2) == (3, 2)   # every worker exactly 3
+    with pytest.raises(ValueError, match="fewer instances"):
+        shard_quota(3, 4, 0)
