@@ -73,6 +73,11 @@ class SoftmaxLayer(LossLayer):
         return jax.nn.softmax(x, axis=-1)
 
     def per_example_loss(self, x: jax.Array, label: jax.Array) -> jax.Array:
+        if label.shape[1] != 1:
+            # reference assert (softmax expects one class-id column)
+            raise ValueError(
+                f"softmax: label width must be 1, got {label.shape[1]} "
+                "(use label_vec to slice the class column)")
         lbl = label[:, 0].astype(jnp.int32)
         logz = jax.nn.logsumexp(x, axis=-1)
         picked = jnp.take_along_axis(x, lbl[:, None], axis=1)[:, 0]
@@ -87,6 +92,12 @@ class L2LossLayer(LossLayer):
     type_name = "l2_loss"
 
     def per_example_loss(self, x: jax.Array, label: jax.Array) -> jax.Array:
+        if label.shape[1] != x.shape[1]:
+            # reference assert (l2_loss: label width == pred width);
+            # silent broadcasting would train a wrong model
+            raise ValueError(
+                f"l2_loss: label width {label.shape[1]} != prediction "
+                f"width {x.shape[1]} (set label_width / label_vec)")
         diff = x - label
         return 0.5 * jnp.sum(diff * diff, axis=-1)
 
@@ -102,5 +113,11 @@ class MultiLogisticLayer(LossLayer):
         return jax.nn.sigmoid(x)
 
     def per_example_loss(self, x: jax.Array, label: jax.Array) -> jax.Array:
+        if label.shape[1] != x.shape[1]:
+            # reference assert (multi_logistic: one target per output)
+            raise ValueError(
+                f"multi_logistic: label width {label.shape[1]} != "
+                f"prediction width {x.shape[1]} (set label_width / "
+                "label_vec)")
         # sum_j [softplus(x) - y*x]  (stable BCE-with-logits)
         return jnp.sum(jax.nn.softplus(x) - label * x, axis=-1)

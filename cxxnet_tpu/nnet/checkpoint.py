@@ -10,6 +10,10 @@ Role parity with the reference model file (SURVEY.md Appendix B:
   resume - sgd_updater-inl.hpp:33-37); we keep that default but support
   `save_optimizer=1` which appends updater state arrays, an explicit
   improvement the format records in the header.
+- pytree paths join nested dict keys with a separator recorded in the
+  header ("/" normally; an ASCII unit separator when a layer name
+  itself contains "/"), so arbitrary config-given layer names
+  round-trip.
 """
 
 from __future__ import annotations
@@ -21,22 +25,43 @@ from typing import Any, BinaryIO, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = b"CXTPU001"
+_ALT_SEP = "\x1f"  # used when a key contains "/"
+_MAX_HEADER = 1 << 30
 
 
-def _flatten(tree: Any, prefix: str = "") -> List[Tuple[str, np.ndarray]]:
+def _flatten(tree: Any, sep: str,
+             prefix: str = "") -> List[Tuple[str, np.ndarray]]:
     out = []
     if isinstance(tree, dict):
         for k in sorted(tree):
-            out.extend(_flatten(tree[k], f"{prefix}/{k}" if prefix else k))
+            out.extend(_flatten(tree[k], sep,
+                                f"{prefix}{sep}{k}" if prefix else k))
     else:
         out.append((prefix, np.asarray(tree)))
     return out
 
 
-def _unflatten(items: Dict[str, np.ndarray]) -> Dict[str, Any]:
+def _keys(tree: Any):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield k
+            yield from _keys(v)
+
+
+def _pick_sep(*trees) -> str:
+    for tree in trees:
+        if tree is None:
+            continue
+        for k in _keys(tree):
+            if "/" in str(k):
+                return _ALT_SEP
+    return "/"
+
+
+def _unflatten(items: Dict[str, np.ndarray], sep: str) -> Dict[str, Any]:
     root: Dict[str, Any] = {}
     for path, arr in items.items():
-        keys = path.split("/")
+        keys = path.split(sep)
         d = root
         for k in keys[:-1]:
             d = d.setdefault(k, {})
@@ -46,12 +71,14 @@ def _unflatten(items: Dict[str, np.ndarray]) -> Dict[str, Any]:
 
 def save_model(fo: BinaryIO, net_type: int, net_structure: dict, epoch: int,
                params: dict, opt_state: Optional[dict] = None) -> None:
-    flat_params = _flatten(params)
-    flat_opt = _flatten(opt_state) if opt_state is not None else []
+    sep = _pick_sep(params, opt_state)
+    flat_params = _flatten(params, sep)
+    flat_opt = _flatten(opt_state, sep) if opt_state is not None else []
     header = {
         "net_type": net_type,
         "net": net_structure,
         "epoch": int(epoch),
+        "sep": sep,
         "params": [
             {"path": p, "dtype": str(a.dtype), "shape": list(a.shape)}
             for p, a in flat_params
@@ -69,26 +96,48 @@ def save_model(fo: BinaryIO, net_type: int, net_structure: dict, epoch: int,
         fo.write(np.ascontiguousarray(a).tobytes())
 
 
+def _read_exact(fi: BinaryIO, n: int, what: str) -> bytes:
+    buf = fi.read(n)
+    if len(buf) != n:
+        raise ValueError(
+            f"invalid model file: truncated while reading {what} "
+            f"(wanted {n} bytes, got {len(buf)})")
+    return buf
+
+
 def load_model(fi: BinaryIO) -> dict:
     """Returns {net_type, net, epoch, params, opt_state or None}."""
     magic = fi.read(len(MAGIC))
     if magic != MAGIC:
         raise ValueError("invalid model file (bad magic)")
-    (hlen,) = struct.unpack("<q", fi.read(8))
-    header = json.loads(fi.read(hlen).decode("utf-8"))
+    (hlen,) = struct.unpack("<q", _read_exact(fi, 8, "header length"))
+    if hlen <= 0 or hlen > _MAX_HEADER:
+        raise ValueError(
+            f"invalid model file: implausible header length {hlen}")
+    try:
+        header = json.loads(_read_exact(fi, hlen, "header").decode("utf-8"))
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ValueError("invalid model file: corrupt header") from e
+    sep = header.get("sep", "/")  # pre-sep files used "/"
 
     def read_arrays(manifest):
         items = {}
         for ent in manifest:
             n = int(np.prod(ent["shape"])) if ent["shape"] else 1
-            dtype = np.dtype(ent["dtype"])
-            buf = fi.read(n * dtype.itemsize)
+            try:
+                dtype = np.dtype(ent["dtype"])
+            except TypeError as e:
+                raise ValueError(
+                    f"invalid model file: unknown dtype {ent['dtype']!r} "
+                    f"for {ent['path']!r}") from e
+            buf = _read_exact(fi, n * dtype.itemsize,
+                              f"array {ent['path']!r}")
             items[ent["path"]] = np.frombuffer(
                 buf, dtype=dtype).reshape(ent["shape"]).copy()
         return items
 
-    params = _unflatten(read_arrays(header["params"]))
-    opt_state = (_unflatten(read_arrays(header["opt_state"]))
+    params = _unflatten(read_arrays(header["params"]), sep)
+    opt_state = (_unflatten(read_arrays(header["opt_state"]), sep)
                  if header["opt_state"] else None)
     return {
         "net_type": header["net_type"],

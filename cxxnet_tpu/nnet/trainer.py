@@ -626,8 +626,11 @@ class NetTrainer:
         if self.profile:
             jax.block_until_ready(self.state["epoch"])
             if self.profiler is not None:
-                self.profiler.add_step(_time.perf_counter() - t0,
-                                       batch.batch_size)
+                # distinct-instance count: wrap/pad rows in
+                # num_batch_padd would inflate images/sec
+                self.profiler.add_step(
+                    _time.perf_counter() - t0,
+                    batch.batch_size - batch.num_batch_padd)
 
     def update_all(self, data_iter, eval_iters=None,
                    eval_names=None) -> None:

@@ -26,7 +26,14 @@ def conv2d(x: jax.Array, w: jax.Array, stride: int, pad_y: int, pad_x: int,
     """Grouped 2-D convolution.
 
     x: (batch, in_ch, h, w); w: (out_ch, in_ch // num_group, ky, kx).
+
+    Precision: f32 operands default to HIGHEST so nominal-f32 training
+    matches the reference's f32 GEMM (TPU's default would silently run
+    bf16 MXU passes); bf16 training (dtype=bfloat16) keeps the fast
+    path - that trade is the user's explicit choice there.
     """
+    if precision is None and x.dtype == jax.numpy.float32:
+        precision = lax.Precision.HIGHEST
     return lax.conv_general_dilated(
         x, w,
         window_strides=(stride, stride),

@@ -149,4 +149,16 @@ def insanity_pool2d(x: jax.Array, rng: jax.Array, ksize_y: int, ksize_x: int,
     flat_idx = (y_src * w + x_src).reshape(b, c, h * w)
     jittered = jnp.take_along_axis(
         x.reshape(b, c, h * w), flat_idx, axis=2).reshape(b, c, h, w)
+    # backward parity (insanity_pooling_layer-inl.hpp
+    # InsanityUnPoolingExp): the gradient credits the window SLOT whose
+    # displaced read won the max - NOT the displaced source pixel.
+    # Straight-through the displacement (identity gradient from the
+    # jittered view back to the same coordinates) so the max-pool
+    # unpool rule below lands the gradient at slot positions, ties
+    # duplicated, exactly like the reference. The zero term is
+    # (x - stop_grad(x)) so the VALUE is bit-exactly `jittered` -
+    # an x + (jit - x) form drifts by 1 ulp and breaks the unpool
+    # rule's exact tie comparisons.
+    jittered = jax.lax.stop_gradient(jittered) \
+        + (x - jax.lax.stop_gradient(x))
     return pool2d(jittered, "max", ksize_y, ksize_x, stride)

@@ -108,6 +108,16 @@ def build_mesh(spec: MeshSpec, batch_size: int,
     """
     devices = list(devices if devices is not None else jax.devices())
     if spec.axes:
+        if spec.device_indices is not None:
+            # `dev = tpu:4-7` + `mesh = ...` composes: the mesh is laid
+            # out over the SELECTED devices, not silently over the
+            # first N of the full list
+            if max(spec.device_indices) >= len(devices):
+                raise ValueError(
+                    f"device spec requests index "
+                    f"{max(spec.device_indices)} but only "
+                    f"{len(devices)} devices are available")
+            devices = [devices[i] for i in spec.device_indices]
         names = [a for a, _ in spec.axes]
         sizes = [k for _, k in spec.axes]
     else:

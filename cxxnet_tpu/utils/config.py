@@ -58,12 +58,19 @@ class _Tokenizer:
             out.append(ch)
 
     def next_token(self) -> str | None:
-        """Return the next token, or None at end of stream."""
+        """Return the next token, or None at end of stream. Sets
+        `last_token_new_line` when a newline (or line comment) was
+        crossed before the token - the reference's new_line flag
+        (config.h GetNextToken), used to reject key/'='/value split
+        across lines."""
         tok: List[str] = []
+        self.last_token_new_line = False
         while self._ch != _EOF:
             ch = self._ch
             if ch == "#":
                 self._skip_line()
+                if not tok:
+                    self.last_token_new_line = True
             elif ch in ('"', "'"):
                 if tok:
                     raise ConfigError(
@@ -80,6 +87,8 @@ class _Tokenizer:
                 self._next_char()
                 if tok:
                     return "".join(tok)
+                if ch in ("\r", "\n"):
+                    self.last_token_new_line = True
             else:
                 tok.append(ch)
                 self._next_char()
@@ -111,9 +120,21 @@ class ConfigIterator:
         if eq != "=":
             raise ConfigError(
                 f"ConfigReader: expected '=' after {name!r}, got {eq!r}")
+        if self._tok.last_token_new_line:
+            # the reference's reader refuses a key/'='/value pair split
+            # across lines (config.h Next's new_line bail) - but it
+            # does so by SILENTLY ignoring the rest of the file; we
+            # fail loudly instead
+            raise ConfigError(
+                f"ConfigReader: '=' for {name!r} must be on the same "
+                "line as the key")
         val = self._tok.next_token()
         if val is None or val == "=":
             raise ConfigError(f"ConfigReader: missing value for {name!r}")
+        if self._tok.last_token_new_line:
+            raise ConfigError(
+                f"ConfigReader: value for {name!r} must be on the same "
+                "line as the key")
         return name, val
 
 

@@ -99,3 +99,41 @@ def test_truncated_boundary_window():
     gr, g = _grad(x, 2, 2)
     expect = numpy_unpool_grad(x, g, 2, 2)
     np.testing.assert_allclose(gr, expect, rtol=1e-6)
+
+
+def test_insanity_pool_backward_credits_slot_positions():
+    """Reference rule (insanity_pooling_layer-inl.hpp unpool): the
+    gradient credits the window SLOT whose displaced read won, not the
+    displaced source pixel - i.e. d/dx insanity_pool(x) equals the
+    max-pool backward evaluated on the jittered view at slot
+    coordinates."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops import pooling as P
+
+    rng = jax.random.PRNGKey(5)
+    x = jnp.asarray(
+        np.random.RandomState(2).randn(1, 2, 4, 4).astype(np.float32))
+
+    g = jax.grad(lambda a: jnp.sum(
+        P.insanity_pool2d(a, rng, 2, 2, 2, p_keep=0.0)))(x)
+
+    # recompute the displaced view with the same rng/algorithm, then
+    # take the max-pool gradient of it AS A LEAF (slot coordinates)
+    b, c, h, w = x.shape
+    flag = jax.random.uniform(rng, (b, c, h, w), dtype=jnp.float32)
+    delta = 0.25
+    ys = jnp.broadcast_to(jnp.arange(h)[None, None, :, None], x.shape)
+    xs = jnp.broadcast_to(jnp.arange(w)[None, None, None, :], x.shape)
+    yd = jnp.where((flag >= 0) & (flag < delta), -1,
+                   jnp.where((flag >= delta) & (flag < 2 * delta), 1, 0))
+    xd = jnp.where((flag >= 2 * delta) & (flag < 3 * delta), -1,
+                   jnp.where(flag >= 3 * delta, 1, 0))
+    idx = (jnp.clip(ys + yd, 0, h - 1) * w
+           + jnp.clip(xs + xd, 0, w - 1)).reshape(b, c, h * w)
+    jittered = jnp.take_along_axis(
+        x.reshape(b, c, h * w), idx, axis=2).reshape(x.shape)
+    expected = jax.grad(lambda v: jnp.sum(
+        P.pool2d(v, "max", 2, 2, 2)))(jittered)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(expected),
+                               rtol=1e-6, atol=1e-6)

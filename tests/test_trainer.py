@@ -524,3 +524,30 @@ def test_round_batch_wrap_rows_are_trained():
     assert np.isfinite(float(out.split(":")[-1]))
     assert np.abs(np.asarray(t.state["params"]["fc1"]["wmat"])
                   - p0).max() > 0
+
+
+def test_checkpoint_slash_in_layer_name_and_corruption():
+    """'/' in a layer name round-trips (separator recorded in the
+    header) and corrupt/truncated files fail with clear ValueErrors."""
+    cfg2 = MLP_CFG.replace("fullc:fc1", "fullc:stage1/fc")
+    cfg2 = cfg2.replace("layer[+1:fc1]", "layer[+1:s1]")
+    t = make_trainer(cfg=cfg2)
+    for b in synth_batches(2):
+        t.update(b)
+    buf = io.BytesIO()
+    t.save_model(buf)
+    t2 = make_trainer(cfg=cfg2)
+    buf.seek(0)
+    t2.load_model(buf)
+    np.testing.assert_allclose(
+        np.asarray(t2.state["params"]["stage1/fc"]["wmat"]),
+        np.asarray(t.state["params"]["stage1/fc"]["wmat"]))
+    # corruption diagnostics
+    from cxxnet_tpu.nnet import checkpoint as ckpt
+    raw = bytearray(buf.getvalue())
+    with pytest.raises(ValueError, match="truncated"):
+        ckpt.load_model(io.BytesIO(bytes(raw[:len(raw) // 2])))
+    bad = bytearray(raw)
+    bad[8:16] = (1 << 60).to_bytes(8, "little")
+    with pytest.raises(ValueError, match="header length"):
+        ckpt.load_model(io.BytesIO(bytes(bad)))
