@@ -454,3 +454,20 @@ def test_insanity_anneal_matches_reference_recurrence():
     layer = make("insanity", [("lb", "2"), ("ub", "10"),
                               ("calm_start", "-1"), ("calm_end", "5")])
     assert layer._range() == (2.0, 10.0)
+
+
+def test_conv_f32_uses_highest_precision():
+    """f32 convs must request HIGHEST precision (reference f32 GEMM
+    parity on TPU - default would run bf16 MXU passes); bf16 inputs
+    keep the fast default."""
+    from cxxnet_tpu.ops.conv import conv2d
+    x32 = jnp.zeros((1, 3, 8, 8), jnp.float32)
+    w32 = jnp.zeros((4, 3, 3, 3), jnp.float32)
+    jaxpr = str(jax.make_jaxpr(
+        lambda x, w: conv2d(x, w, 1, 1, 1))(x32, w32))
+    assert "HIGHEST" in jaxpr, jaxpr
+    xb = x32.astype(jnp.bfloat16)
+    wb = w32.astype(jnp.bfloat16)
+    jaxpr_b = str(jax.make_jaxpr(
+        lambda x, w: conv2d(x, w, 1, 1, 1))(xb, wb))
+    assert "HIGHEST" not in jaxpr_b, jaxpr_b
