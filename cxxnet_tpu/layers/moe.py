@@ -26,6 +26,19 @@ Config keys: nexpert, nhidden (per-expert FFN hidden), moe_top_k
 (default 1), moe_aux (default 0.01), moe_capacity (0 = dense exact
 compute; >0 = Switch/GShard capacity-factor sparse dispatch, tokens
 over capacity dropped), no_bias.
+
+moe_capacity caveat: the layer itself adds NO residual - a dropped
+(over-capacity) token's output is exactly 0, so a config using
+`moe_capacity > 0` must wire a residual bypass around the layer or
+dropped tokens lose their activations entirely, e.g.::
+
+    layer[3->4,5] = split
+    layer[4->6] = moe:moe1
+      moe_capacity = 1.25
+    layer[5,6->7] = add
+
+(the Switch/GShard formulation assumes exactly this residual;
+infer_shapes warns when capacity is enabled).
 """
 
 from __future__ import annotations
@@ -74,6 +87,14 @@ class MoELayer(Layer):
             raise ValueError("moe: must set nhidden correctly")
         if not (1 <= self.top_k <= self.nexpert):
             raise ValueError("moe: moe_top_k out of range")
+        if self.capacity > 0:
+            import warnings
+            warnings.warn(
+                f"moe:{self.name}: moe_capacity={self.capacity} drops "
+                "over-capacity tokens (output 0); wire a residual "
+                "bypass around this layer (split + add, see the moe "
+                "module docstring) or dropped tokens lose their "
+                "activations", stacklevel=2)
         return [in_shapes[0]]
 
     def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:

@@ -136,11 +136,9 @@ class NetTrainer:
             # compile-at-trace model creates the need). NOTE: the cache
             # is PROCESS-GLOBAL jax state (one cache per process, last
             # writer wins) - not per-trainer.
-            jax.config.update("jax_compilation_cache_dir", val)
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.0)
-            jax.config.update(
-                "jax_persistent_cache_min_entry_size_bytes", 0)
+            from cxxnet_tpu.utils.platform import \
+                set_compilation_cache_dir
+            set_compilation_cache_dir(val)
         if name.startswith("metric"):
             import re
             m = re.match(r"^metric\[([^,\]]+),([^\]]+)\]$", name)
@@ -525,8 +523,19 @@ class NetTrainer:
         imap = shd.devices_indices_map((self.batch_size,))
         spans = {imap[d][0].indices(self.batch_size)[:2]
                  for d in shd.addressable_devices}
-        return (sum(stop - start for start, stop in spans),
-                min(start for start, _ in spans))
+        total = sum(stop - start for start, stop in spans)
+        lo = min(start for start, _ in spans)
+        hi = max(stop for _, stop in spans)
+        if total != hi - lo:
+            # put_global_rows slices the host batch as ONE contiguous
+            # range; a mesh/device ordering that fragments a process's
+            # row ownership would silently feed wrong rows - fail loudly
+            raise RuntimeError(
+                f"process-local batch rows are not contiguous: spans="
+                f"{sorted(spans)} over batch {self.batch_size} (mesh "
+                f"device order fragments row ownership; reorder the "
+                f"mesh axes or devices so each process owns one range)")
+        return total, lo
 
     @property
     def _local_batch(self) -> int:

@@ -66,18 +66,24 @@ def naive_attention(q, k, v, *, causal: bool = False,
 
 def attention_partial(q, k, v, *, scale: Optional[float] = None,
                       causal: bool = False, q_offset=0, kv_offset=0,
+                      kv_valid: Optional[int] = None,
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One K/V block's contribution as an online-softmax partial.
 
     Returns (acc [B,H,Sq,D] f32 unnormalized, m [B,H,Sq] f32 row max,
     l [B,H,Sq] f32 denominator). Offsets place the blocks on the global
-    sequence for causal masking (traced values allowed)."""
+    sequence for causal masking (traced values allowed). `kv_valid`
+    masks key GLOBAL positions >= kv_valid - the tail-padding mask for
+    callers that pad K/V up to a block-size multiple."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32)
     s = s.astype(jnp.float32) * _scale(q, scale)
     if causal:
         s = s + _causal_bias(q.shape[2], k.shape[2],
                              q_offset, kv_offset)[None, None]
+    if kv_valid is not None:
+        kpos = kv_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where((kpos < kv_valid)[None, None], s, _NEG)
     m = jnp.max(s, axis=-1)
     # keep fully-masked rows finite: their p rows are exp(_NEG - _NEG)=1
     # scaled below by where(), so force p=0 via the mask itself
@@ -127,15 +133,19 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     Wrap in jax.checkpoint (remat=1) for the O(S) memory backward."""
     sk = k.shape[2]
     kv_block = min(kv_block, sk)
-    if sk % kv_block != 0:
-        # static shapes: use the largest divisor <= kv_block so the
-        # O(Sq x kv_block) score-memory bound survives any block size
-        # (falling back to one full block would defeat the point at
-        # exactly the long sequences this exists for)
-        kv_block = next(b for b in range(kv_block, 0, -1) if sk % b == 0)
-    nblk = sk // kv_block
+    if nblk_pad := (-sk) % kv_block:
+        # static shapes: pad K/V up to the next block multiple and mask
+        # the tail (kv_valid). A divisor fallback would degrade to
+        # kv_block=1 - an S-iteration serial scan - on prime/odd
+        # lengths, exactly the long sequences this exists for.
+        pad = ((0, 0), (0, 0), (0, nblk_pad), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    kv_valid = sk if nblk_pad else None
+    nblk = k.shape[2] // kv_block
     if nblk == 1:
-        acc, m, l = attention_partial(q, k, v, scale=scale, causal=causal)
+        acc, m, l = attention_partial(q, k, v, scale=scale, causal=causal,
+                                      kv_valid=kv_valid)
         return finalize_partial(acc, l, q.dtype)
 
     kb = k.reshape(k.shape[0], k.shape[1], nblk, kv_block, k.shape[3])
@@ -146,7 +156,8 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     def step(carry, xs):
         kv_i, k_i, v_i = xs
         part = attention_partial(q, k_i, v_i, scale=scale, causal=causal,
-                                 q_offset=0, kv_offset=kv_i * kv_block)
+                                 q_offset=0, kv_offset=kv_i * kv_block,
+                                 kv_valid=kv_valid)
         return merge_partials(carry, part), None
 
     init = empty_partial(q)

@@ -86,11 +86,22 @@ def _ring_jit(q, k, v, mesh, causal, scale):
         perm = [(j, (j + 1) % n) for j in range(n)]
 
         def partial_at(part, k_cur, v_cur, t):
-            p = attention_partial(q, k_cur, v_cur, scale=scale,
-                                  causal=causal,
-                                  q_offset=idx * s_local,
-                                  kv_offset=((idx - t) % n) * s_local)
-            return merge_partials(part, p)
+            blk = (idx - t) % n
+
+            def compute(part):
+                p = attention_partial(q, k_cur, v_cur, scale=scale,
+                                      causal=causal,
+                                      q_offset=idx * s_local,
+                                      kv_offset=blk * s_local)
+                return merge_partials(part, p)
+
+            if not causal:
+                return compute(part)
+            # causal: a K/V block from a strictly-later rank is entirely
+            # in this Q block's masked future - skip its partial (the
+            # naive schedule burns ~2x the needed FLOPs; the rotation
+            # still happens, so correctness is carry-identical)
+            return lax.cond(blk > idx, lambda p: p, compute, part)
 
         def step(carry, t):
             k_cur, v_cur, part = carry

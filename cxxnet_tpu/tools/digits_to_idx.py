@@ -37,8 +37,12 @@ def write_idx(out_dir: str, prefix: str, images: np.ndarray,
         f.write(np.ascontiguousarray(labels, np.uint8).tobytes())
 
 
-def build(out_dir: str, test_fraction: float = 0.2,
-          seed: int = 0) -> tuple:
+def load_split(test_fraction: float = 0.2, seed: int = 0) -> tuple:
+    """The canonical acceptance split: (train_x, train_y, test_x,
+    test_y), images uint8 (n, 28, 28). ONE function owns the
+    upsampling + shuffle so the framework acceptance runs and the
+    same-split external baselines (docs/acceptance/baseline_mlp.py)
+    provably train on identical data."""
     from scipy import ndimage
     from sklearn.datasets import load_digits
 
@@ -53,11 +57,17 @@ def build(out_dir: str, test_fraction: float = 0.2,
     order = rng.permutation(len(up))
     n_test = int(len(up) * test_fraction)
     test_idx, train_idx = order[:n_test], order[n_test:]
+    return (up[train_idx], labels[train_idx],
+            up[test_idx], labels[test_idx])
 
+
+def build(out_dir: str, test_fraction: float = 0.2,
+          seed: int = 0) -> tuple:
+    train_x, train_y, test_x, test_y = load_split(test_fraction, seed)
     os.makedirs(out_dir, exist_ok=True)
-    write_idx(out_dir, "train", up[train_idx], labels[train_idx])
-    write_idx(out_dir, "t10k", up[test_idx], labels[test_idx])
-    return len(train_idx), n_test
+    write_idx(out_dir, "train", train_x, train_y)
+    write_idx(out_dir, "t10k", test_x, test_y)
+    return len(train_x), len(test_x)
 
 
 def main(argv) -> int:

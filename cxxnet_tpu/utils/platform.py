@@ -21,3 +21,26 @@ def ensure_env_platform() -> None:
         jax.config.update("jax_platforms", want)
     except RuntimeError:
         pass  # backend already initialized
+
+
+def set_compilation_cache_dir(path: str) -> None:
+    """Point XLA's persistent compilation cache at `path` (and make
+    tiny/fast compiles eligible, so tests can observe it).
+
+    jax initializes the process-global cache object ONCE, at the first
+    cached compile - a later `jax_compilation_cache_dir` update changes
+    the config value but the live cache keeps writing to the old dir.
+    jax 0.9 has no public reset, so force re-initialization through the
+    private flags (guarded: on any jax-internals drift the config
+    update alone still works for the first-writer case)."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        from jax._src import compilation_cache as cc
+        with cc._cache_initialized_mutex:
+            cc._cache_initialized = False
+            cc._cache = None
+    except Exception:  # noqa: BLE001 - private-API drift must not break
+        pass

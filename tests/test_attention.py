@@ -45,6 +45,29 @@ def test_blockwise_matches_naive(causal, kv_block):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_prime_length_pads_not_degrades(causal):
+    """Non-divisible (prime) sequence lengths pad K/V to a block
+    multiple with a masked tail - correctness AND structure: the scan
+    must run ceil(s/kv_block) trips, not degrade to kv_block=1 (an
+    S-iteration serial scan, the pre-round-4 fallback)."""
+    q, k, v = _qkv(s=13)
+    ref = A.naive_attention(q, k, v, causal=causal)
+    out = A.blockwise_attention(q, k, v, causal=causal, kv_block=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    gr = _grads(lambda *a: A.naive_attention(*a, causal=causal), q, k, v)
+    gb = _grads(lambda *a: A.blockwise_attention(
+        *a, causal=causal, kv_block=4), q, k, v)
+    for a, b in zip(gr, gb):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+    jaxpr = jax.make_jaxpr(lambda q, k, v: A.blockwise_attention(
+        q, k, v, causal=causal, kv_block=4))(q, k, v)
+    scans = [e for e in jaxpr.eqns if e.primitive.name == "scan"]
+    assert scans and scans[0].params["length"] == 4  # ceil(13/4)
+
+
 def test_partial_merge_is_order_insensitive():
     q, k, v = _qkv(s=12)
     p1 = A.attention_partial(q, k[:, :, :4], v[:, :, :4])
@@ -100,6 +123,25 @@ def test_ring_matches_naive(causal, axes):
     out = R.ring_attention(qs, ks, vs, mesh, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_ring_causal_skips_future_blocks():
+    """The causal ring schedule must gate each rotated K/V block's
+    partial behind a conditional (fully-future blocks are skipped -
+    without it the ring does ~2x the needed attention FLOPs). The
+    non-causal schedule has no such gate."""
+    mesh = _mesh([("seq", 4)])
+    q, k, v = _qkv(b=1, h=2, s=8, d=4)
+
+    def hlo(causal):
+        return R._ring_jit.lower(q, k, v, mesh, causal, None).as_text()
+
+    def has_cond(txt):
+        return ("stablehlo.if" in txt or "stablehlo.case" in txt
+                or "conditional" in txt)
+
+    assert has_cond(hlo(True))
+    assert not has_cond(hlo(False))
 
 
 @pytest.mark.parametrize("causal", [False, True])

@@ -1,0 +1,98 @@
+"""bench.py harness smoke test - ALWAYS in the default suite.
+
+Round-3 post-mortem: bench.py called the jitted train step with a
+stale 5-arg signature; nothing in the (green) suite imported the
+measurement functions, so the regression reached the driver's on-chip
+run and zeroed the round's headline artifact (BENCH_r03 value=0.0).
+This test runs the REAL harness end-to-end on the CPU backend at a
+tiny batch so any drift in the train-step signature, sharding specs,
+or the extras plumbing fails the suite, not the round.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_bench_run_end_to_end(monkeypatch, tmp_path):
+    """bench.run() produces a complete artifact with nonzero numbers
+    and no *_error fields from any CPU-reachable path."""
+    # keep the suite's compile cache out of the repo checkout
+    monkeypatch.setenv("CXN_BENCH_CACHE_DIR", str(tmp_path / "cache"))
+    import bench
+    out = bench.run(steps_override=1, batch_override=4)
+
+    assert out["platform"] == "cpu"
+    assert out["value"] > 0 and out["compute_ips"] > 0
+    assert out["value_is"] == "e2e"
+    assert out["unit"] == "images/sec"
+    # the eval_train variant exercises the metric-compiled step
+    assert out["e2e_eval_train_ips"] > 0
+    # the input-split extra runs on CPU too
+    assert out["host_prep_ms_p50"] > 0
+    assert out["device_step_ms_p50"] > 0
+    assert out["augment_ips"] > 0
+    errors = {k: v for k, v in out.items() if k.endswith("_error")}
+    assert not errors, errors
+    # the artifact is the driver contract: one JSON-serializable dict
+    json.dumps(out)
+
+
+def test_bench_partial_snapshot_discipline(monkeypatch, tmp_path):
+    """The watchdog's emergency artifact (_PARTIAL) must carry the
+    headline fields after the first measurement: a hang in ANY later
+    stage may only truncate extras, never zero the value."""
+    monkeypatch.setenv("CXN_BENCH_CACHE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("CXN_BENCH_EVALTRAIN", "0")
+    monkeypatch.setenv("CXN_BENCH_SPLIT", "0")
+    import bench
+    monkeypatch.setattr(bench, "_PARTIAL", {})
+    bench.run(steps_override=1, batch_override=4)
+    snap = bench._PARTIAL
+    assert snap["value"] > 0
+    assert snap["value_is"] == "e2e"
+    assert snap["compute_ips"] > 0
+
+
+def test_bench_crash_after_measurement_emits_snapshot(monkeypatch, capsys):
+    """A CRASH (not just a hang) after a completed measurement must
+    emit the snapshotted headline, never the value=0.0 error artifact
+    (the round-3 failure mode applied to the exception path)."""
+    import bench
+    monkeypatch.setattr(bench, "_PARTIAL", {})
+
+    def boom(profile_dir="", steps_override=0, batch_override=0):
+        bench._snapshot({"metric": "m", "value": 123.0, "unit":
+                         "images/sec", "compute_ips": 123.0})
+        raise RuntimeError("late explosion")
+
+    monkeypatch.setattr(bench, "run", boom)
+    monkeypatch.setenv("CXN_BENCH_TIMEOUT", "0")
+    assert bench.main([]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 123.0
+    assert "late explosion" in out["truncated"]
+
+
+def test_bench_crash_before_measurement_emits_error(monkeypatch, capsys):
+    import bench
+    monkeypatch.setattr(bench, "_PARTIAL", {})
+    monkeypatch.setattr(bench, "run", lambda *a, **k: (_ for _ in ()
+                                                      ).throw(
+        ValueError("early explosion")))
+    monkeypatch.setenv("CXN_BENCH_TIMEOUT", "0")
+    assert bench.main([]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.0 and "early explosion" in out["error"]
+
+
+def test_bench_error_artifact_is_json():
+    """A crash before any measurement must still print the one-line
+    JSON contract (value 0.0 + error), rc=0."""
+    import bench
+    line = bench._error_json("boom")
+    d = json.loads(line)
+    assert d["value"] == 0.0 and "boom" in d["error"]

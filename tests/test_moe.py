@@ -75,6 +75,20 @@ def test_shape_and_validation():
         _layer(top_k=9).infer_shapes([(2, 1, 4, 8)])
 
 
+def test_capacity_warns_about_residual():
+    """moe_capacity > 0 zeroes dropped tokens' outputs; the layer must
+    tell the config author to wire a residual bypass (the layer itself
+    adds none). The default dense route stays silent."""
+    import warnings
+    m = _layer()
+    m.set_param("moe_capacity", "1.25")
+    with pytest.warns(UserWarning, match="residual"):
+        m.infer_shapes([(2, 1, 4, 8)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _layer().infer_shapes([(2, 1, 4, 8)])
+
+
 def test_full_topk_equals_dense_mixture():
     """top_k == nexpert makes the routed sum the full softmax mixture -
     an analytically checkable reference."""

@@ -70,6 +70,45 @@ def test_ulysses_uses_all_to_all():
     assert _ag_elems(hlo) == 0, "ulysses must not all-gather K/V"
 
 
+def test_composed_mesh_collective_set():
+    """dp x sp x pp composed in ONE mesh and ONE jitted train step
+    (the 8-device slice of dryrun_multichip's phase 5; the 16-device
+    run adds 'model'): the compiled HLO must carry the whole collective
+    set docs/parallel.md's scaling analysis claims - an all-reduce
+    (gradient dp sum), collective-permutes from BOTH the ring K/V
+    rotation and the GPipe activation flow, and no all-gather of the
+    stacked stage params."""
+    from __graft_entry__ import _TINY_COMPOSED, _make_trainer
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    # no ZeRO here: shard_optimizer=1 all-gathers every updated param
+    # by design, which would swamp the no-stage-param-gather bound (the
+    # ZeRO + composed-mesh execution is dryrun_multichip phase 5)
+    t = _make_trainer(
+        parse_config_string(_TINY_COMPOSED),
+        [("batch_size", "4"), ("mesh", "data:2,seq:2,pipe:2"),
+         ("silent", "1"), ("eval_train", "0")])
+    assert "seq" in str(t._data_sharded.spec)
+    assert t._pshard["ts1"]["wqkv"].spec[0] == "pipe"
+    data = np.zeros((4, 1, 8, 16), np.float32)
+    labels = {"label": np.zeros((4, 1), np.float32)}
+    mask = np.ones(4, np.float32)
+    hlo = t._train_step.lower(
+        t.state, data, (), labels, mask,
+        jax.random.PRNGKey(0)).compile().as_text()
+    assert _count(hlo, "all-reduce") >= 1, "no gradient AllReduce"
+    # ring rotation (n-1 = 1 fwd step + transpose) and pipeline flow
+    # are distinct ppermutes; both schedules must appear
+    assert _count(hlo, "collective-permute") >= 2, (
+        "ring + pipeline ppermutes missing: "
+        f"{_count(hlo, 'collective-permute')}")
+    stack_elems = sum(int(np.prod(p.shape))
+                      for p in t.state["params"]["ts1"].values())
+    assert _ag_elems(hlo) < stack_elems, (
+        "stacked stage params appear to be gathered: "
+        f"all-gather elems {_ag_elems(hlo)} >= stack {stack_elems}")
+
+
 def test_pipeline_step_keeps_stage_params_sharded():
     """The pipelined train step moves activations with ppermute and
     never all-gathers the stacked stage params (the 1/P weight-HBM
