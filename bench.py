@@ -350,6 +350,32 @@ def _bench_input_split(trainer, batch, platform: str) -> dict:
         return {"split_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_stage_f32(trainer, batch, steps, platform: str) -> dict:
+    """e2e with `stage_dtype = float32`: stage f32 (2x H2D bytes) and
+    let the jitted step cast to bf16 ON DEVICE (fused into the first
+    conv) instead of the host-side ml_dtypes cast (~70 ms single-thread
+    for an AlexNet b256 batch - potentially several device-steps'
+    worth). Whichever of `value` vs `e2e_f32stage_ips` wins tells
+    round 5 which side of the host-CPU/link trade this environment
+    sits on. Costs one retrace of the same step for the f32 aval.
+    TPU only (the host-vs-link trade does not exist on the CPU
+    backend, and the f32-aval retrace is a second full compile the
+    fallback budget cannot afford). Disable with CXN_BENCH_STAGEF32=0."""
+    if platform != "tpu" or os.environ.get("CXN_BENCH_STAGEF32") == "0":
+        return {}
+    try:
+        if trainer.compute_dtype == np.float32:
+            return {}  # f32 compute already stages f32; nothing to vary
+        trainer.stage_dtype = "float32"
+        try:
+            ips = _measure_e2e(trainer, batch, steps)
+        finally:
+            trainer.stage_dtype = ""
+        return {"e2e_f32stage_ips": round(ips, 2)}
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"stage_f32_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_googlenet(batch, steps, platform: str) -> dict:
     """Second model family (BASELINE config #5): GoogLeNet e2e
     images/sec at reduced steps - the concat-heavy inception graph
@@ -516,6 +542,8 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     out.update(_bench_input_split(trainer, batch, platform))
     _snapshot(out)
     out.update(_bench_attention(platform))
+    _snapshot(out)
+    out.update(_bench_stage_f32(trainer, batch, steps, platform))
     _snapshot(out)
     out.update(_bench_googlenet(batch, steps, platform))
     _snapshot(out)

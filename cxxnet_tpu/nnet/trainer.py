@@ -72,6 +72,7 @@ class NetTrainer:
         self._loaded_opt = None
         self.save_optimizer = 0
         self.shard_optimizer = 0
+        self.stage_dtype = ""   # "" = follow compute_dtype
         self.remat = 0
         self.model_format = "native"
         self.profile = 0
@@ -116,6 +117,10 @@ class NetTrainer:
             self.shard_optimizer = 1
         if name == "remat":
             self.remat = int(val)
+        if name == "stage_dtype":
+            if val not in ("", "float32", "bfloat16"):
+                raise ValueError("stage_dtype must be float32 or bfloat16")
+            self.stage_dtype = val
         if name == "model_format":
             if val not in ("native", "cxxnet"):
                 raise ValueError("model_format must be native or cxxnet")
@@ -156,6 +161,13 @@ class NetTrainer:
     # initialization
     # ------------------------------------------------------------------
     def init_model(self) -> None:
+        if (self.stage_dtype == "bfloat16"
+                and self.compute_dtype == jnp.float32):
+            # would silently stage f32 anyway (_host_input): reject the
+            # no-op combination instead of hiding a misconfiguration
+            raise ValueError(
+                "stage_dtype=bfloat16 requires dtype=bfloat16 "
+                "(f32 compute always stages f32)")
         # param_server=dist -> join the multi-controller job before any
         # device is touched (replaces InitParamServer,
         # nnet_impl-inl.hpp:376-390)
@@ -307,10 +319,18 @@ class NetTrainer:
             if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
     def _host_input(self, data: np.ndarray) -> np.ndarray:
-        """Input image batch as staged to device. Under dtype=bfloat16
-        the cast happens on the HOST, halving the H2D transfer (the
-        step's _cast then no-ops on it; labels/mask stay f32)."""
-        if self.compute_dtype == jnp.float32:
+        """Input image batch as staged to device.
+
+        Under dtype=bfloat16 the default stages bf16: the cast happens
+        on the HOST, halving the H2D transfer (the step's _cast then
+        no-ops; labels/mask stay f32). `stage_dtype = float32` flips
+        the trade: stage f32 (2x bytes) and let the step's in-jit
+        _cast do it on DEVICE, fused into the first conv - wins when
+        the host CPU, not the link, is the staging bottleneck (an
+        AlexNet b256 host cast is ~40M elements, tens of ms
+        single-threaded; bench.py measures both as e2e variants)."""
+        if (self.compute_dtype == jnp.float32
+                or self.stage_dtype == "float32"):
             return data.astype(np.float32)
         import ml_dtypes
         return data.astype(ml_dtypes.bfloat16)

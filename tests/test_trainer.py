@@ -243,6 +243,31 @@ def test_bfloat16_host_cast_input_path():
     assert t.predict(b).shape == (16,)
 
 
+def test_stage_dtype_f32_matches_host_cast():
+    """stage_dtype=float32 stages f32 and lets the jitted step cast to
+    bf16 on device (fused) - the identical round-to-nearest-even, so
+    the training trajectory matches the host-cast path exactly."""
+    import ml_dtypes
+    t1 = make_trainer(extra="dtype = bfloat16\n")
+    t2 = make_trainer(extra="dtype = bfloat16\nstage_dtype = float32\n")
+    assert t2._host_input(np.ones((2, 1), np.float32)).dtype == np.float32
+    assert t1._host_input(np.ones((2, 1), np.float32)).dtype \
+        == ml_dtypes.bfloat16
+    for b in synth_batches(4):
+        t1.update(b)
+        t2.update(b)
+    np.testing.assert_allclose(
+        np.asarray(t1.state["params"]["fc1"]["wmat"]),
+        np.asarray(t2.state["params"]["fc1"]["wmat"]),
+        rtol=1e-6, atol=1e-7)
+    with pytest.raises(ValueError, match="stage_dtype"):
+        make_trainer(extra="stage_dtype = int8\n")
+    # bf16 staging under f32 compute can never take effect: reject the
+    # silent no-op instead of hiding a misconfiguration
+    with pytest.raises(ValueError, match="requires dtype=bfloat16"):
+        make_trainer(extra="stage_dtype = bfloat16\n")
+
+
 def test_remat_matches_plain():
     """remat=1 (jax.checkpoint over the forward) changes memory, not
     math: training trajectories are identical."""
