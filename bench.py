@@ -376,6 +376,48 @@ def _bench_stage_f32(trainer, batch, steps, platform: str) -> dict:
         return {"stage_f32_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_device_augment(batch, steps, platform: str) -> dict:
+    """e2e with `device_augment = 1`: raw 3x256x256 uint8 batches
+    (50 MB H2D vs 79 MB bf16 / 158 MB f32 crops) with crop / mirror /
+    mean / scale fused into the jitted step - the measured AFTER for
+    the device-side-augmentation go/no-go (docs/perf.md): compare
+    `device_augment_ips` against `value` (host-prepped crops) and the
+    host augment ceiling (`augment_ips` x cores). TPU only (one more
+    full compile). Disable with CXN_BENCH_DAUG=0."""
+    if platform != "tpu" or os.environ.get("CXN_BENCH_DAUG") == "0":
+        return {}
+    try:
+        import jax
+        from __graft_entry__ import _ALEXNET_CONF, _make_trainer
+        from cxxnet_tpu.io.data import DataBatch
+        from cxxnet_tpu.utils.config import parse_config_file
+        tr = _make_trainer(
+            parse_config_file(_ALEXNET_CONF),
+            [("batch_size", str(batch)), ("dev", "tpu"), ("silent", "1"),
+             ("eval_train", "0"), ("save_model", "0"),
+             ("device_augment", "1"), ("rand_crop", "1"),
+             ("rand_mirror", "1"), ("mean_value", "104,117,123"),
+             ("image_mean", "")])
+        rng = np.random.RandomState(5)
+        nbuf = min(8, steps)
+        batches = [DataBatch(
+            data=rng.randint(0, 256, (batch, 3, 256, 256),
+                             dtype=np.uint8).astype(np.uint8),
+            label=rng.randint(0, 1000, (batch, 1)).astype(np.float32))
+            for _ in range(nbuf)]
+        for i in range(2):
+            tr.update(batches[i % nbuf])
+        jax.block_until_ready(tr.state)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            tr.update(batches[i % nbuf])
+        jax.block_until_ready(tr.state)
+        dt = time.perf_counter() - t0
+        return {"device_augment_ips": round(steps * batch / dt, 2)}
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"device_augment_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_googlenet(batch, steps, platform: str) -> dict:
     """Second model family (BASELINE config #5): GoogLeNet e2e
     images/sec at reduced steps - the concat-heavy inception graph
@@ -544,6 +586,8 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     out.update(_bench_attention(platform))
     _snapshot(out)
     out.update(_bench_stage_f32(trainer, batch, steps, platform))
+    _snapshot(out)
+    out.update(_bench_device_augment(batch, steps, platform))
     _snapshot(out)
     out.update(_bench_googlenet(batch, steps, platform))
     _snapshot(out)

@@ -200,6 +200,7 @@ class AugmentIterator(DataIter):
         self.max_random_illumination = 0.0
         self.max_random_contrast = 0.0
         self.shape = None  # (c, y, x)
+        self.device_augment = 0
         self.aug = ImageAugmenter()
         self.rng = np.random.RandomState(self.K_RAND_MAGIC)
         self.meanimg: Optional[np.ndarray] = None
@@ -235,6 +236,8 @@ class AugmentIterator(DataIter):
         if name == "mean_value":
             self.mean_b, self.mean_g, self.mean_r = (
                 float(t) for t in val.split(","))
+        if name == "device_augment":
+            self.device_augment = int(val)
         self.aug.set_param(name, val)
 
     def init(self) -> None:
@@ -261,6 +264,20 @@ class AugmentIterator(DataIter):
 
     # ------------------------------------------------------------------
     def _set_data(self, d: DataInst) -> None:
+        if self.device_augment:
+            # passthrough: stage the RAW decoded image; crop / mirror /
+            # mean / scale run inside the jitted step
+            # (ops/augment_jit.py). Affine warps cannot be deferred -
+            # they run scipy on the host.
+            if self.aug.need_process():
+                raise ValueError(
+                    "device_augment=1 cannot defer affine augmenters "
+                    "(rotate/shear/aspect/random-scale run on the "
+                    "host); disable them or device_augment")
+            self._out = DataInst(index=d.index,
+                                 data=np.ascontiguousarray(d.data),
+                                 label=d.label, extra_data=d.extra_data)
+            return
         data = self.aug.process(d.data, self.rng)
         c, ty, tx = self.shape
 

@@ -56,7 +56,11 @@ class BatchAdaptIterator(DataIter):
         self._head = 1
 
     def _collect(self, insts) -> DataBatch:
-        data = np.stack([d.data for d in insts]).astype(np.float32)
+        # uint8 instances (device_augment raw passthrough) stay uint8:
+        # the 1/4-size H2D staging is the point of that mode
+        data = np.stack([d.data for d in insts])
+        if data.dtype != np.uint8:
+            data = data.astype(np.float32, copy=False)
         label = np.zeros((len(insts), self.label_width), dtype=np.float32)
         for i, d in enumerate(insts):
             w = min(self.label_width, len(d.label))

@@ -28,11 +28,16 @@ from cxxnet_tpu.utils.binary_page import iter_page_blobs
 
 
 def decode_image(blob: bytes) -> np.ndarray:
-    """JPEG/PNG bytes -> (c, h, w) float32 RGB in [0,255]."""
+    """JPEG/PNG bytes -> (c, h, w) uint8 RGB in [0,255].
+
+    uint8 is both reference-faithful (cv::Mat u8 end to end) and what
+    device_augment staging wants (1/4 the f32 H2D bytes); the host
+    augmentation path casts to f32 per instance exactly where the
+    reference does (augment.py _set_data)."""
     from PIL import Image
     img = Image.open(_io.BytesIO(blob))
     img = img.convert("RGB")
-    arr = np.asarray(img, dtype=np.float32)  # (h, w, 3)
+    arr = np.asarray(img)  # (h, w, 3) uint8
     return np.ascontiguousarray(arr.transpose(2, 0, 1))
 
 
@@ -180,6 +185,7 @@ class ImageBinIterator(DataIter):
         self.use_native = -1
         self.decode_threads = 4
         self.shuffle_buffer = 1024
+        self.device_augment = 0
         self._native = None
         self._native_mode = False
         self._pool = None  # Python-path decode ThreadPoolExecutor
@@ -211,6 +217,10 @@ class ImageBinIterator(DataIter):
             self.decode_threads = int(val)
         if name == "shuffle_buffer":
             self.shuffle_buffer = int(val)
+        if name == "device_augment":
+            # raw uint8 staging for the in-step augment path: the
+            # native pipeline converts to CHW uint8 instead of CHW f32
+            self.device_augment = int(val)
 
     def _expand_templates(self) -> Tuple[List[str], List[str]]:
         """image_conf_prefix with %d + image_conf_ids `a-b` -> shard lists
@@ -264,7 +274,8 @@ class ImageBinIterator(DataIter):
             from cxxnet_tpu.io.native import NativeBinReader
             if self._native is None:
                 self._native = NativeBinReader(
-                    self.bins, n_threads=self.decode_threads)
+                    self.bins, n_threads=self.decode_threads,
+                    out_mode=2 if self.device_augment else 1)
             self._native.before_first()
             self._nseq = 0
             self._nbuf: List[DataInst] = []

@@ -93,27 +93,33 @@ def native_available() -> bool:
 
 
 class NativeBinReader:
-    """Ordered record stream over one or more .bin files."""
+    """Ordered record stream over one or more .bin files.
+
+    out_mode 1 (default): (c,h,w) float32 CHW, converted on the native
+    worker threads - the host-augmentation layout. out_mode 2: (c,h,w)
+    uint8 CHW - device-side augmentation staging (device_augment=1),
+    1/4 the f32 bytes end-to-end."""
 
     def __init__(self, bin_paths: List[str], n_threads: int = 4,
-                 max_inflight: int = 64):
+                 max_inflight: int = 64, out_mode: int = 1):
         lib = _load()
         if lib is None:
             raise RuntimeError("native io library unavailable")
         self._lib = lib
+        self._mode = out_mode
         arr = (ctypes.c_char_p * len(bin_paths))(
             *[p.encode() for p in bin_paths])
         self._h = lib.cxio_open(arr, len(bin_paths), n_threads,
-                                max_inflight, 1)
+                                max_inflight, out_mode)
         self._rec = CxioRecord()
 
     def before_first(self) -> None:
         self._lib.cxio_before_first(self._h)
 
     def next(self) -> Optional[np.ndarray]:
-        """Next decoded image as (c,h,w) float32 RGB, or the raw blob
-        decoded via PIL when the native decoders could not handle it.
-        None at end of stream (raises on stream error)."""
+        """Next decoded image as (c,h,w) CHW (f32 or u8 per out_mode),
+        or the raw blob decoded via PIL when the native decoders could
+        not handle it. None at end of stream (raises on stream error)."""
         if not self._lib.cxio_next(self._h, ctypes.byref(self._rec)):
             err = self._lib.cxio_last_error(self._h)
             if err:
@@ -123,11 +129,16 @@ class NativeBinReader:
         if r.c == 0:  # undecodable natively; PIL fallback on the raw blob
             from cxxnet_tpu.io.iter_img import decode_image
             blob = ctypes.string_at(r.data, r.w)
-            return decode_image(blob)
-        # float mode: the record already is CHW float32 (converted on the
-        # native worker threads); one memcpy to own the buffer
-        fptr = ctypes.cast(r.data, ctypes.POINTER(ctypes.c_float))
+            img = decode_image(blob)  # uint8 CHW
+            return img if self._mode == 2 else img.astype(np.float32)
         n = r.h * r.w * r.c
+        if self._mode == 2:
+            u8 = ctypes.cast(r.data, ctypes.POINTER(ctypes.c_uint8))
+            return np.ctypeslib.as_array(u8, shape=(n,)).reshape(
+                r.c, r.h, r.w).copy()
+        # the record already is CHW float32 (converted on the native
+        # worker threads); one memcpy to own the buffer
+        fptr = ctypes.cast(r.data, ctypes.POINTER(ctypes.c_float))
         return np.ctypeslib.as_array(fptr, shape=(n,)).reshape(
             r.c, r.h, r.w).copy()
 

@@ -136,9 +136,28 @@ class LearnTask:
 
     # ------------------------------------------------------------------
     def _create_net(self) -> NetTrainer:
+        """Build the trainer from the global + TRAIN-data sections.
+
+        The reference feeds every conf line to every component; we keep
+        that for the global and data sections but EXCLUDE eval/pred
+        iterator blocks: their keys are iterator-scoped (an eval block
+        without rand_crop must not clobber the train block's
+        device_augment crop spec - the blocks appear later in the file,
+        so a flat last-writer-wins scan would take the eval values)."""
         net = NetTrainer()
+        flag = 0
         for k, v in self.cfg:
-            net.set_param(k, v)
+            if k == "data":
+                flag = 1
+                continue
+            if k in ("eval", "pred"):
+                flag = 2
+                continue
+            if k == "iter" and v == "end":
+                flag = 0
+                continue
+            if flag != 2:
+                net.set_param(k, v)
         return net
 
     def init(self) -> None:

@@ -23,6 +23,7 @@ forward-only executable.
 from __future__ import annotations
 
 import io as _io
+import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -73,6 +74,12 @@ class NetTrainer:
         self.save_optimizer = 0
         self.shard_optimizer = 0
         self.stage_dtype = ""   # "" = follow compute_dtype
+        self.device_augment = 0
+        # augment spec, shared config keys with the host iterator
+        # pipeline (the CLI feeds every conf pair to every component,
+        # reference-style, so these arrive without extra wiring)
+        self._daug_cfg: Dict[str, str] = {}
+        self._augment_fn = None
         self.remat = 0
         self.model_format = "native"
         self.profile = 0
@@ -121,6 +128,19 @@ class NetTrainer:
             if val not in ("", "float32", "bfloat16"):
                 raise ValueError("stage_dtype must be float32 or bfloat16")
             self.stage_dtype = val
+        if name == "device_augment":
+            self.device_augment = int(val)
+        if name in ("image_mean", "mean_value", "scale", "divideby",
+                    "rand_crop", "rand_mirror", "mirror",
+                    "crop_y_start", "crop_x_start",
+                    "max_random_contrast", "max_random_illumination"):
+            # crop/mirror/mean/scale spec for device_augment=1 (same
+            # key names the host AugmentIterator consumes; ignored
+            # unless device_augment is set). divideby is the
+            # reciprocal-scale alias, like augment.py's handler.
+            if name == "divideby":
+                name, val = "scale", str(1.0 / float(val))
+            self._daug_cfg[name] = val
         if name == "model_format":
             if val not in ("native", "cxxnet"):
                 raise ValueError("model_format must be native or cxxnet")
@@ -329,9 +349,19 @@ class NetTrainer:
         the host CPU, not the link, is the staging bottleneck (an
         AlexNet b256 host cast is ~40M elements, tens of ms
         single-threaded; bench.py measures both as e2e variants)."""
+        if self.device_augment and data.dtype == np.uint8:
+            # raw pixels stage as uint8: 1/4 the f32 H2D bytes and
+            # ZERO host arithmetic; the in-step augment casts on device
+            return data
         if (self.compute_dtype == jnp.float32
-                or self.stage_dtype == "float32"):
-            return data.astype(np.float32)
+                or self.stage_dtype == "float32"
+                or (self.device_augment and self.stage_dtype != "bfloat16")):
+            # device_augment defaults to f32 staging (integer pixel
+            # values; no host cast) - stage_dtype=bfloat16 opts into
+            # the halved transfer at host-cast cost (lossless for
+            # integer-valued pixels <= 256). copy=False: an
+            # already-f32 batch must not pay a 150 MB memcpy
+            return data.astype(np.float32, copy=False)
         import ml_dtypes
         return data.astype(ml_dtypes.bfloat16)
 
@@ -366,8 +396,48 @@ class NetTrainer:
         from cxxnet_tpu.layers.base import active_step
         from cxxnet_tpu.parallel.mesh import active_mesh
 
+        daug = None
+        if self.device_augment:
+            from cxxnet_tpu.ops.augment_jit import make_device_augment
+            dc = self._daug_cfg
+            mean_loader = None
+            if dc.get("image_mean"):
+                def mean_loader(path=dc["image_mean"]):
+                    # lazy: called at TRACE time (first update), after
+                    # the iterator's init had its chance to create the
+                    # mean file on a fresh dataset
+                    if not os.path.exists(path):
+                        raise FileNotFoundError(
+                            f"device_augment: mean image '{path}' not "
+                            "found; run the data pipeline once (the "
+                            "iterator creates it) or point image_mean "
+                            "at an existing mean file")
+                    from cxxnet_tpu.io.augment import load_mean_image
+                    return load_mean_image(path)
+            mean_values = None
+            if dc.get("mean_value"):
+                b_, g_, r_ = (float(t)
+                              for t in dc["mean_value"].split(","))
+                mean_values = (b_, g_, r_)
+            daug = make_device_augment(
+                tuple(self.net_cfg.input_shape),
+                mean_loader=mean_loader, mean_values=mean_values,
+                scale=float(dc.get("scale", "1.0")),
+                rand_crop=int(dc.get("rand_crop", "0")),
+                rand_mirror=int(dc.get("rand_mirror", "0")),
+                mirror=int(dc.get("mirror", "0")),
+                crop_y_start=int(dc.get("crop_y_start", "-1")),
+                crop_x_start=int(dc.get("crop_x_start", "-1")),
+                max_random_contrast=float(
+                    dc.get("max_random_contrast", "0")),
+                max_random_illumination=float(
+                    dc.get("max_random_illumination", "0")))
+        self._augment_fn = daug
+
         def loss_fn(params, data, extras, labels, mask, rng, step):
             cparams = self._cast(params)
+            if daug is not None:
+                data = daug(data, jax.random.fold_in(rng, 0xA6), True)
             inputs = {0: self._cast(data)}
             for i, e in enumerate(extras):
                 inputs[1 + i] = self._cast(e)
@@ -439,6 +509,10 @@ class NetTrainer:
 
         def eval_step(params, data, extras):
             cparams = self._cast(params)
+            if daug is not None:
+                # deterministic eval augment (center crop, no mirror/
+                # jitter); the key is never consumed on this path
+                data = daug(data, jax.random.PRNGKey(0), False)
             inputs = {0: self._cast(data)}
             for i, e in enumerate(extras):
                 inputs[1 + i] = self._cast(e)

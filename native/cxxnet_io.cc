@@ -38,20 +38,25 @@ constexpr int64_t kPageSize = 4 * kPageNumInts;  // 64 MiB
 
 struct Decoded {
   std::vector<unsigned char> pixels;  // HWC RGB u8, or raw blob on failure
-  std::vector<float> chw;             // CHW float32 when float mode is on
+  std::vector<float> chw;             // CHW float32 (out_mode 1)
+  std::vector<unsigned char> chw_u8;  // CHW uint8 (out_mode 2)
   int h = 0, w = 0, c = 0;            // c == 0 -> pixels holds the raw blob
 };
 
-// HWC u8 -> CHW float32 (the DataInst layout), done on the worker thread
-// so the Python consumer gets a zero-copy ready tensor.
-void ToChwFloat(Decoded* d) {
+// HWC u8 -> CHW (the DataInst layout), done on the worker thread so
+// the Python consumer gets a ready tensor. T = float (out_mode 1) or
+// unsigned char (out_mode 2, device-side augmentation staging: raw
+// pixels stay uint8 end-to-end for a 1/4-size H2D transfer).
+template <typename T>
+void ToChw(const Decoded* d, std::vector<T>* out) {
   const size_t hw = static_cast<size_t>(d->h) * d->w;
-  d->chw.resize(hw * d->c);
+  const size_t c = static_cast<size_t>(d->c);
+  out->resize(hw * c);
   const unsigned char* src = d->pixels.data();
-  for (int ch = 0; ch < d->c; ++ch) {
-    float* dst = d->chw.data() + ch * hw;
+  for (size_t ch = 0; ch < c; ++ch) {
+    T* dst = out->data() + ch * hw;
     const unsigned char* s = src + ch;
-    for (size_t i = 0; i < hw; ++i) dst[i] = s[i * 3];
+    for (size_t i = 0; i < hw; ++i) dst[i] = static_cast<T>(s[i * c]);
   }
 }
 
@@ -146,11 +151,11 @@ struct Task {
 class Pipeline {
  public:
   Pipeline(std::vector<std::string> paths, int n_threads, int max_inflight,
-           bool float_chw)
+           int out_mode)
       : paths_(std::move(paths)),
         n_threads_(std::max(1, n_threads)),
         max_inflight_(std::max(2, max_inflight)),
-        float_chw_(float_chw) {}
+        out_mode_(out_mode) {}
 
   ~Pipeline() { Stop(); }
 
@@ -292,7 +297,8 @@ class Pipeline {
       }
       Decoded d;
       DecodeBlob(std::move(task.blob), &d);
-      if (float_chw_ && d.c > 0) ToChwFloat(&d);
+      if (out_mode_ == 1 && d.c > 0) ToChw(&d, &d.chw);
+      else if (out_mode_ == 2 && d.c > 0) ToChw(&d, &d.chw_u8);
       {
         std::lock_guard<std::mutex> lk(mu_);
         done_.emplace(task.seq, std::move(d));
@@ -305,7 +311,7 @@ class Pipeline {
   std::vector<std::string> paths_;
   int n_threads_;
   int max_inflight_;
-  bool float_chw_;
+  int out_mode_;
 
   std::thread reader_;
   std::vector<std::thread> workers_;
@@ -329,7 +335,7 @@ struct Handle {
   std::vector<std::string> paths;
   int n_threads = 4;
   int max_inflight = 64;
-  bool float_chw = false;
+  int out_mode = 0;
 };
 
 }  // namespace
@@ -345,22 +351,23 @@ typedef struct {
   int h, w, c;                // c == 0: undecodable blob, byte length in w
 } CxioRecord;
 
-// float_chw != 0: records come back as CHW float32 (DataInst layout),
-// converted on the worker threads.
+// out_mode 1: records come back as CHW float32 (DataInst layout);
+// out_mode 2: CHW uint8 (device-side augmentation staging); 0: HWC u8.
+// Conversion runs on the worker threads either way.
 void* cxio_open(const char* const* bin_paths, int n_bins, int n_threads,
-                int max_inflight, int float_chw) {
+                int max_inflight, int out_mode) {
   auto* h = new Handle();
   for (int i = 0; i < n_bins; ++i) h->paths.emplace_back(bin_paths[i]);
   if (n_threads > 0) h->n_threads = n_threads;
   if (max_inflight > 0) h->max_inflight = max_inflight;
-  h->float_chw = float_chw != 0;
+  h->out_mode = out_mode;
   return h;
 }
 
 void cxio_before_first(void* handle) {
   auto* h = static_cast<Handle*>(handle);
   h->pipe.reset(new Pipeline(h->paths, h->n_threads, h->max_inflight,
-                             h->float_chw));
+                             h->out_mode));
   h->pipe->Start();
 }
 
@@ -371,9 +378,11 @@ int cxio_next(void* handle, CxioRecord* rec) {
     h->last_error = h->pipe->error();
     return 0;
   }
-  if (h->float_chw && h->current.c > 0) {
+  if (h->out_mode == 1 && h->current.c > 0) {
     rec->data = reinterpret_cast<const unsigned char*>(
         h->current.chw.data());
+  } else if (h->out_mode == 2 && h->current.c > 0) {
+    rec->data = h->current.chw_u8.data();
   } else {
     rec->data = h->current.pixels.data();
   }

@@ -89,6 +89,17 @@ def test_bench_crash_before_measurement_emits_error(monkeypatch, capsys):
     assert out["value"] == 0.0 and "early explosion" in out["error"]
 
 
+def test_bench_device_augment_extra_runs(monkeypatch, tmp_path):
+    """The device_augment bench extra builds its own AlexNet trainer
+    with override keys that must track the trainer's config surface -
+    run it for real (tiny batch; the platform gate is bypassed, the
+    CPU backend executes) so drift degrades a test, not the artifact."""
+    monkeypatch.setenv("CXN_BENCH_CACHE_DIR", str(tmp_path / "cache"))
+    import bench
+    out = bench._bench_device_augment(4, 1, "tpu")
+    assert out.get("device_augment_ips", 0) > 0, out
+
+
 def test_bench_error_artifact_is_json():
     """A crash before any measurement must still print the one-line
     JSON contract (value 0.0 + error), rc=0."""

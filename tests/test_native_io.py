@@ -59,6 +59,23 @@ def test_native_reader_jpeg_decodes(tmp_path):
     r.close()
 
 
+def test_native_reader_u8_mode_matches_pil(tmp_path):
+    """out_mode=2 (device_augment staging): CHW uint8 from the worker
+    threads, byte-identical to the PIL u8 decode."""
+    from cxxnet_tpu.io.iter_img import load_image_file
+    lst, root, bin_path, _ = _make_bin(tmp_path)
+    r = NativeBinReader([bin_path], n_threads=3, out_mode=2)
+    r.before_first()
+    for i in range(12):
+        got = r.next()
+        assert got.dtype == np.uint8
+        expect = load_image_file(f"{root}img_{i}.png")
+        assert expect.dtype == np.uint8
+        np.testing.assert_array_equal(got, expect)
+    assert r.next() is None
+    r.close()
+
+
 def test_native_reader_restart(tmp_path):
     _, _, bin_path, _ = _make_bin(tmp_path, n=5)
     r = NativeBinReader([bin_path])
