@@ -140,19 +140,24 @@ def _measure_compute(trainer, batch, steps):
     key = jax.random.PRNGKey(0)
 
     state = trainer.state
-    # warmup (compile + first run); the host readback of the loss forces
-    # true completion - block_until_ready alone does not flush the
-    # dispatch queue on tunneled platforms
+    # warmup (compile + first run). block_until_ready, NEVER a host
+    # readback: on the tunneled platform a single D2H transfer costs
+    # tens of seconds AND stickily degrades all subsequent H2D staging
+    # to ~25 MB/s (measured round 4: one scalar np.asarray() on an idle
+    # queue took 48 s and cut the e2e loop from ~1,500 to ~70 img/s for
+    # the rest of the process). block_until_ready waits for completion
+    # without transferring - verified against the device profile
+    # (33 ms/step blocked == 33 ms/step profiled device time).
     for i in range(3):
         state, loss = trainer._train_step(
             state, data, (), labels, mask, jax.random.fold_in(key, i))
-    float(np.asarray(loss))
+    jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
     for i in range(steps):
         state, loss = trainer._train_step(
             state, data, (), labels, mask, jax.random.fold_in(key, i))
-    float(np.asarray(loss))
+    jax.block_until_ready(loss)
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
     trainer.state = state
@@ -577,21 +582,27 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
                                  / (peak_tflops * ndev), 2))
     _snapshot(out)
 
-    # extras, cheapest/highest-value first, snapshot after each so a
-    # hang in extra k never costs extras 1..k-1
-    out.update(_bench_top_ops(trainer, batch, platform))
-    _snapshot(out)
-    out.update(_bench_input_split(trainer, batch, platform))
-    _snapshot(out)
-    out.update(_bench_attention(platform))
-    _snapshot(out)
+    # extras, snapshot after each so a hang in extra k never costs
+    # extras 1..k-1. ORDER MATTERS on the tunneled platform: every
+    # throughput measurement runs BEFORE the profiler trace
+    # (_bench_top_ops), whose trace collection is a large D2H fetch -
+    # D2H transfers stickily degrade subsequent H2D staging to
+    # ~25 MB/s (see _measure_compute), which round 4 measured as a
+    # 20x e2e collapse. Nothing before the profiler may transfer
+    # device data to the host.
     out.update(_bench_stage_f32(trainer, batch, steps, platform))
     _snapshot(out)
     out.update(_bench_device_augment(batch, steps, platform))
     _snapshot(out)
     out.update(_bench_googlenet(batch, steps, platform))
     _snapshot(out)
+    out.update(_bench_input_split(trainer, batch, platform))
+    _snapshot(out)
+    out.update(_bench_attention(platform))
+    _snapshot(out)
     out.update(_bench_eval_train(make, batch, steps))
+    _snapshot(out)
+    out.update(_bench_top_ops(trainer, batch, platform))
     _snapshot(out)
     return out
 

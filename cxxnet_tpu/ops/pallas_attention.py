@@ -48,6 +48,17 @@ _NEG = -1e30
 BLOCK_Q = 128
 BLOCK_K = 128
 
+# Mosaic requires the last two dims of every block shape to be
+# (sublane, lane)-tileable: divisible by (8, 128) or equal to the
+# array dims. A per-row stat laid out as (b, h, s) with block
+# (1, 1, bq) violates that (second-to-last block dim 1 vs array dim
+# h), so lse/delta ride a trailing broadcast dim of 8 - block
+# (1, 1, bq, 8) is (128, 8)-tiled, and 8 == the array dim satisfies
+# the lane rule (same trick as jax's reference flash kernel, which
+# uses a trailing MIN_BLOCK_SIZE=128; 8 costs 16x less HBM for the
+# saved residual).
+_STAT_LANES = 8
+
 
 def _sublane(dtype) -> int:
     return 16 if dtype == jnp.bfloat16 else 8
@@ -114,7 +125,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
     def _out():
         safe = jnp.where(l[:] > 0, l[:], 1.0)
         o_ref[0, 0] = (acc[:] / safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m[:] + jnp.log(safe)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            (m[:] + jnp.log(safe))[:, None], (bq, _STAT_LANES))
 
 
 def _fwd(q, k, v, scale, causal, interpret) -> Tuple[jax.Array, jax.Array]:
@@ -131,9 +143,11 @@ def _fwd(q, k, v, scale, causal, interpret) -> Tuple[jax.Array, jax.Array]:
         grid=(b, h, nq, nkv),
         in_specs=[qspec, kspec, kspec],
         out_specs=[qspec,
-                   pl.BlockSpec((1, 1, bq), lambda b, h, qi, ki: (b, h, qi))],
+                   pl.BlockSpec((1, 1, bq, _STAT_LANES),
+                                lambda b, h, qi, ki: (b, h, qi, 0))],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
-                   jax.ShapeDtypeStruct((b, h, s), jnp.float32)],
+                   jax.ShapeDtypeStruct((b, h, s, _STAT_LANES),
+                                        jnp.float32)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((bq,), jnp.float32),
                         pltpu.VMEM((bq,), jnp.float32)],
@@ -169,11 +183,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = kv_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(kpos <= qpos, s, _NEG)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
         dov = jax.lax.dot_general(
             do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dov - delta_ref[0, 0][:, None])
+        ds = p * (dov - delta_ref[0, 0][:, :1])
         acc[:] += scale * jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -209,7 +223,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = kv_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(kpos <= qpos, s, _NEG)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])          # (bq, bk)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])            # (bq, bk)
         do = do_ref[0, 0]
         accv[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -217,7 +231,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dov = jax.lax.dot_general(
             do, v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dov - delta_ref[0, 0][:, None])        # (bq, bk)
+        ds = p * (dov - delta_ref[0, 0][:, :1])          # (bq, bk)
         acck[:] += scale * jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bk, d)
@@ -241,10 +255,13 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret):
     nq, nkv = s // bq, sk // bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # (b, h, s)
+    delta = jnp.broadcast_to(delta[..., None],
+                             (*delta.shape, _STAT_LANES))
 
     qspec = pl.BlockSpec((1, 1, bq, d), lambda b, h, qi, ki: (b, h, qi, 0))
     kspec = pl.BlockSpec((1, 1, bk, d), lambda b, h, qi, ki: (b, h, ki, 0))
-    rspec = pl.BlockSpec((1, 1, bq), lambda b, h, qi, ki: (b, h, qi))
+    rspec = pl.BlockSpec((1, 1, bq, _STAT_LANES),
+                         lambda b, h, qi, ki: (b, h, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nkv=nkv),
@@ -262,7 +279,8 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, interpret):
     # swapped grid: kv outer, q inner (sequential) so dk/dv accumulate
     qspec2 = pl.BlockSpec((1, 1, bq, d), lambda b, h, ki, qi: (b, h, qi, 0))
     kspec2 = pl.BlockSpec((1, 1, bk, d), lambda b, h, ki, qi: (b, h, ki, 0))
-    rspec2 = pl.BlockSpec((1, 1, bq), lambda b, h, ki, qi: (b, h, qi))
+    rspec2 = pl.BlockSpec((1, 1, bq, _STAT_LANES),
+                          lambda b, h, ki, qi: (b, h, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq),
