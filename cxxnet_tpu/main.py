@@ -135,30 +135,127 @@ class LearnTask:
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
-    def _create_net(self) -> NetTrainer:
-        """Build the trainer from the global + TRAIN-data sections.
-
-        The reference feeds every conf line to every component; we keep
-        that for the global and data sections but EXCLUDE eval/pred
-        iterator blocks: their keys are iterator-scoped (an eval block
-        without rand_crop must not clobber the train block's
-        device_augment crop spec - the blocks appear later in the file,
-        so a flat last-writer-wins scan would take the eval values)."""
-        net = NetTrainer()
+    def _split_blocks(self):
+        """Segment the flat conf into (defcfg, train, evals, pred):
+        defcfg = keys outside any iterator block, train/pred = that
+        block's keys, evals = [(eval_name, keys), ...]. The ONE
+        scanner both _create_net and _create_iterators consume - the
+        two previous hand-rolled copies had already drifted (pred
+        folded into eval, train keys in/out of defcfg). Also records
+        self.name_pred from the `pred =` line."""
+        defcfg: List[Tuple[str, str]] = []
+        train = None
+        evals: List[Tuple[str, List[Tuple[str, str]]]] = []
+        pred = None
+        cur: Optional[List[Tuple[str, str]]] = None
+        evname = ""
         flag = 0
-        for k, v in self.cfg:
-            if k == "data":
-                flag = 1
+        for name, val in self.cfg:
+            if name == "data":
+                flag, cur = 1, []
                 continue
-            if k in ("eval", "pred"):
-                flag = 2
+            if name == "eval":
+                flag, cur, evname = 2, [], val
                 continue
-            if k == "iter" and v == "end":
-                flag = 0
+            if name == "pred":
+                flag, cur = 3, []
+                self.name_pred = val
                 continue
-            if flag != 2:
-                net.set_param(k, v)
+            if name == "iter" and val == "end":
+                assert flag != 0, "wrong configuration file"
+                if flag == 1:
+                    assert train is None, "can only have one data"
+                    train = cur
+                elif flag == 2:
+                    evals.append((evname, cur))
+                else:
+                    assert pred is None, "can only have one data:test"
+                    pred = cur
+                flag, cur = 0, None
+                continue
+            (defcfg if cur is None else cur).append((name, val))
+        return defcfg, train, evals, pred
+
+    @staticmethod
+    def _daug_spec(pairs) -> dict:
+        """Canonical device-augment normalization spec from conf pairs
+        (last-writer-wins): divideby folds into scale exactly as the
+        trainer's own alias does, and defaults are filled so an
+        explicit `mirror = 0` compares equal to an absent one."""
+        spec = {"scale": 1.0, "mirror": "0", "crop_y_start": "-1",
+                "crop_x_start": "-1", "image_mean": "", "mean_value": "",
+                "input_shape": "", "device_augment": "0"}
+        for k, v in pairs:
+            if k == "divideby":
+                spec["scale"] = 1.0 / float(v)
+            elif k == "scale":
+                spec["scale"] = float(v)
+            elif k in spec:
+                spec[k] = v
+        return spec
+
+    def _create_net(self) -> NetTrainer:
+        """Build the trainer from the global section + the train data
+        block (every task - the historic spec source), plus the pred
+        block layered last UNDER task=pred/extract only (so the
+        feeding iterator's image_mean/scale reaches the
+        device_augment eval spec). The pred block must NOT feed under
+        task=train - iterator-scoped keys like a pred batch_size
+        would silently clobber the train configuration - and eval
+        blocks never feed (an eval block without rand_crop must not
+        erase the train block's crop)."""
+        defcfg, train, evals, pred = self._split_blocks()
+        feed = defcfg + (train or [])
+        if self.task in ("pred", "extract"):
+            feed = feed + (pred or [])
+        net = NetTrainer()
+        for k, v in feed:
+            net.set_param(k, v)
+        self._check_daug_blocks(net, feed, defcfg, train, evals, pred)
         return net
+
+    def _check_daug_blocks(self, net, feed, defcfg, train, evals, pred):
+        """device_augment bakes ONE normalization spec into the jitted
+        step, but every iterator block feeds it raw pixels. A block
+        whose effective spec diverges from the trainer's would be
+        silently normalized with the WRONG spec - fail loudly instead.
+        Only blocks the CURRENT task instantiates are checked (a conf
+        shared between train and pred must not be rejected for a
+        divergence in a block the task never uses). `feed` is exactly
+        what _create_net fed the trainer, so eff IS the compiled
+        spec."""
+        active = []
+        if self.task in ("pred", "extract"):
+            if pred is not None:
+                active.append(("pred", pred))
+        else:
+            if train is not None:
+                active.append(("data", train))
+            active.extend((name or "eval", keys) for name, keys in evals)
+        eff = self._daug_spec(feed)
+        want = "1" if net.device_augment else "0"
+        for tag, keys in active:
+            bs = self._daug_spec(defcfg + keys)
+            flag = "1" if int(bs["device_augment"] or "0") else "0"
+            if flag != want:
+                raise ValueError(
+                    f"device_augment mismatch: the trainer compiled "
+                    f"with device_augment={want} but iterator block "
+                    f"'{tag}' has device_augment={flag} - raw pixels "
+                    "and the in-step augment must agree. Set "
+                    "device_augment globally, not per block.")
+            if not net.device_augment:
+                continue
+            for k in ("scale", "mirror", "crop_y_start", "crop_x_start",
+                      "image_mean", "mean_value", "input_shape"):
+                if bs[k] != eff[k]:
+                    raise ValueError(
+                        f"device_augment: block '{tag}' has {k}="
+                        f"{bs[k]!r} but the trainer's compiled spec "
+                        f"has {k}={eff[k]!r}; the in-step augment is "
+                        "compiled once - per-block normalization "
+                        "divergence cannot be honored (use the host "
+                        "pipeline, device_augment=0, for that)")
 
     def init(self) -> None:
         # param_server=dist: join the multi-controller job up front so
@@ -237,41 +334,16 @@ class LearnTask:
 
     # ------------------------------------------------------------------
     def _create_iterators(self) -> None:
-        flag = 0
-        evname = ""
-        itcfg: List[Tuple[str, str]] = []
-        defcfg: List[Tuple[str, str]] = []
-        for name, val in self.cfg:
-            if name == "data":
-                flag = 1
-                continue
-            if name == "eval":
-                evname = val
-                flag = 2
-                continue
-            if name == "pred":
-                flag = 3
-                self.name_pred = val
-                continue
-            if name == "iter" and val == "end":
-                assert flag != 0, "wrong configuration file"
-                if flag == 1 and self.task not in ("pred", "extract"):
-                    assert self.itr_train is None, "can only have one data"
-                    self.itr_train = create_iterator(itcfg)
-                if flag == 2 and self.task not in ("pred", "extract"):
-                    self.itr_evals.append(create_iterator(itcfg))
-                    self.eval_names.append(evname)
-                if flag == 3 and self.task in ("pred", "extract"):
-                    assert self.itr_pred is None, \
-                        "can only have one data:test"
-                    self.itr_pred = create_iterator(itcfg)
-                flag = 0
-                itcfg = []
-                continue
-            if flag == 0:
-                defcfg.append((name, val))
-            else:
-                itcfg.append((name, val))
+        defcfg, train, evals, pred = self._split_blocks()
+        if self.task in ("pred", "extract"):
+            if pred is not None:
+                self.itr_pred = create_iterator(pred)
+        else:
+            if train is not None:
+                self.itr_train = create_iterator(train)
+            for evname, itcfg in evals:
+                self.itr_evals.append(create_iterator(itcfg))
+                self.eval_names.append(evname)
 
         def init_iter(it):
             for k, v in defcfg:

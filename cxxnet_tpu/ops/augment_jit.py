@@ -65,10 +65,17 @@ def make_device_augment(out_shape: Shape3,
     mean_loader: nullary callable returning the (c, ry, rx)- or
     (c, ty, tx)-shaped f32 mean array (or None) - called lazily at
     trace time, AFTER the iterator had its chance to create the mean
-    file on first use. Mutually exclusive with mean_values=(b, g, r)
-    (the reference's config order).
+    file on first use. When both are configured, mean_values wins and
+    the mean image is never loaded - the host pipeline's precedence
+    (io/augment.py:313 checks the per-channel values first).
     """
     c, ty, tx = out_shape
+    if mean_values is not None and not any(mean_values):
+        # all-zero mean_value is OFF on the host path (the branch tests
+        # mean_r/g/b > 0), which also disables contrast/illumination
+        mean_values = None
+    if mean_values is not None:
+        mean_loader = None
 
     def apply(data, rng, train: bool):
         b, dc, ry, rx = data.shape
@@ -94,13 +101,29 @@ def make_device_augment(out_shape: Shape3,
             xx = jnp.full((b,), xx_max // 2, jnp.int32)
         # fixed crop offsets (crop_y/x_start) override BOTH the center
         # default and a random draw, exactly like the host path
-        # (augment.py applies them after the rand_crop branch)
+        # (augment.py applies them after the rand_crop branch). Range-
+        # check here: dynamic_slice CLAMPS out-of-range offsets, which
+        # would silently train on shifted windows where the host path
+        # fails on the resulting shape mismatch
         if yy_max and crop_y_start != -1:
+            if not 0 <= crop_y_start <= yy_max:
+                raise ValueError(
+                    f"device_augment: crop_y_start={crop_y_start} out "
+                    f"of range [0, {yy_max}] for raw {ry} crop {ty}")
             yy = jnp.full((b,), crop_y_start, jnp.int32)
         if xx_max and crop_x_start != -1:
+            if not 0 <= crop_x_start <= xx_max:
+                raise ValueError(
+                    f"device_augment: crop_x_start={crop_x_start} out "
+                    f"of range [0, {xx_max}] for raw {rx} crop {tx}")
             xx = jnp.full((b,), crop_x_start, jnp.int32)
         if train and rand_mirror:
+            # mirror=1 still forces EVERY sample - the host path ORs
+            # the flags (io/augment.py:309-310), it does not let the
+            # random draw override the unconditional mirror
             mir = jax.random.bernoulli(k_m, 0.5, (b,))
+            if mirror:
+                mir = jnp.ones((b,), bool)
         else:
             mir = jnp.full((b,), bool(mirror))
         # host-pipeline parity quirk: contrast/illumination only apply
@@ -127,16 +150,20 @@ def make_device_augment(out_shape: Shape3,
         def one(img, yy, xx, mir, contrast, illum):
             x = jax.lax.dynamic_slice(
                 img, (0, yy, xx), (c, ty, tx)).astype(jnp.float32)
-            if mean_c is not None:
+            if mean_values is not None:
+                # host precedence: per-channel values beat the mean
+                # image (augment.py:313; subtraction only at c == 3,
+                # but contrast/illumination apply regardless)
+                if c == 3:
+                    mb, mg, mr = mean_values
+                    x = x - jnp.asarray([mr, mg, mb],
+                                        jnp.float32)[:, None, None]
+            elif mean_c is not None:
                 # crop-then-subtract == subtract-then-crop (elementwise)
                 m = (jax.lax.dynamic_slice(mean_c, (0, yy, xx),
                                            (c, ty, tx))
                      if raw_mean else mean_c)
                 x = x - m
-            elif mean_values is not None and c == 3:
-                mb, mg, mr = mean_values
-                x = x - jnp.asarray([mr, mg, mb],
-                                    jnp.float32)[:, None, None]
             x = x * contrast + illum
             # mirror AFTER the subtraction (the host path mirrors the
             # mean-subtracted crop, not the raw pixels)

@@ -78,8 +78,14 @@ def test_contrast_without_mean_is_skipped_like_host():
                              max_random_illumination=9.0)
     out = np.asarray(fn(raw, jax.random.PRNGKey(1), train=True))
     np.testing.assert_allclose(out, raw[:, :, 1:7, 1:7], rtol=1e-6)
-    # with a mean configured, the jitter DOES apply
-    fn2 = make_device_augment((3, 6, 6), mean_values=(0.0, 0.0, 0.0),
+    # an ALL-ZERO mean_value is OFF on the host path too (the branch
+    # tests mean_r/g/b > 0), so jitter still must not apply
+    fn0 = make_device_augment((3, 6, 6), mean_values=(0.0, 0.0, 0.0),
+                              max_random_illumination=9.0)
+    out0 = np.asarray(fn0(raw, jax.random.PRNGKey(1), train=True))
+    np.testing.assert_allclose(out0, raw[:, :, 1:7, 1:7], rtol=1e-6)
+    # with a real mean configured, the jitter DOES apply
+    fn2 = make_device_augment((3, 6, 6), mean_values=(1.0, 2.0, 3.0),
                               max_random_illumination=9.0)
     out2 = np.asarray(fn2(raw, jax.random.PRNGKey(1), train=True))
     assert not np.allclose(out2, raw[:, :, 1:7, 1:7])
@@ -307,3 +313,191 @@ def test_trainer_device_augment_random_trains_and_evals():
     p2 = t.predict(bs[0])
     np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
     assert p1.shape == (8,)
+
+
+def test_mirror_flag_forces_every_sample_under_rand_mirror():
+    """Host parity: do_mirror = (rand_mirror and u<0.5) or mirror==1
+    (io/augment.py:309-310) - mirror=1 must win over the random draw,
+    not be ignored by it."""
+    rng = np.random.RandomState(7)
+    raw = rng.randn(16, 3, 8, 8).astype(np.float32)
+    fn = make_device_augment((3, 8, 8), rand_mirror=1, mirror=1)
+    out = np.asarray(fn(raw, jax.random.PRNGKey(3), train=True))
+    np.testing.assert_allclose(out, raw[:, :, :, ::-1], rtol=1e-6)
+
+
+def test_mean_value_beats_mean_image_like_host():
+    """Host precedence: the per-channel mean_value branch is checked
+    FIRST (io/augment.py:313); a configured mean image must not shadow
+    it on the device path."""
+    rng = np.random.RandomState(8)
+    raw = rng.randint(0, 256, (2, 3, 6, 6)).astype(np.float32)
+    meanimg = rng.randn(3, 6, 6).astype(np.float32)
+    fn = make_device_augment((3, 6, 6), mean_loader=lambda: meanimg,
+                             mean_values=(1.0, 2.0, 3.0))
+    out = np.asarray(fn(raw, jax.random.PRNGKey(0), train=False))
+    ref = raw - np.asarray([3.0, 2.0, 1.0],
+                           np.float32)[None, :, None, None]
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_out_of_range_fixed_crop_fails_loudly():
+    """dynamic_slice clamps; a misconfigured crop_y_start must raise
+    (the host path errors on the short slice), not train shifted."""
+    raw = np.zeros((1, 3, 10, 10), np.float32)
+    fn = make_device_augment((3, 8, 8), crop_y_start=5)
+    with pytest.raises(ValueError, match="crop_y_start"):
+        fn(raw, jax.random.PRNGKey(0), train=True)
+
+
+def test_cli_rejects_divergent_eval_block_under_device_augment(tmp_path):
+    """device_augment bakes ONE normalization spec into the step; an
+    eval block with a different image_mean/scale would silently be
+    normalized with the train spec - the CLI must reject it."""
+    from cxxnet_tpu.main import LearnTask
+    conf = tmp_path / "c.conf"
+    conf.write_text("""
+device_augment = 1
+netconfig=start
+layer[0->1] = flatten
+layer[1->2] = fullc
+  nhidden = 4
+layer[2->2] = softmax
+netconfig=end
+input_shape = 1,6,6
+batch_size = 4
+eta = 0.1
+data = train
+iter = mnist
+  scale = 1.0
+iter = end
+eval = test
+iter = mnist
+  scale = 0.5
+iter = end
+""")
+    task = LearnTask()
+    task.set_param("silent", "1")
+    for k, v in __import__(
+            "cxxnet_tpu.utils.config",
+            fromlist=["parse_config_file"]).parse_config_file(str(conf)):
+        task.set_param(k, v)
+    with pytest.raises(ValueError, match="scale"):
+        task._create_net()
+
+
+def _mk_task(conf_text, task="train"):
+    from cxxnet_tpu.main import LearnTask
+    from cxxnet_tpu.utils.config import parse_config_string
+    t = LearnTask()
+    t.set_param("silent", "1")
+    for k, v in parse_config_string(conf_text):
+        t.set_param(k, v)
+    t.set_param("task", task)
+    return t
+
+
+_DAUG_CONF_HEAD = """
+device_augment = 1
+netconfig=start
+layer[0->1] = flatten
+layer[1->2] = fullc
+  nhidden = 4
+layer[2->2] = softmax
+netconfig=end
+input_shape = 1,6,6
+batch_size = 4
+eta = 0.1
+"""
+
+
+def test_equivalent_block_spec_is_not_rejected():
+    """An eval block that restates the compiled defaults (mirror=0) or
+    the scale via its divideby alias is IDENTICAL, not divergent - the
+    canonicalized comparison must accept it."""
+    t = _mk_task(_DAUG_CONF_HEAD + """
+scale = 0.00390625
+data = train
+iter = mnist
+iter = end
+eval = test
+iter = mnist
+  mirror = 0
+  divideby = 256
+iter = end
+""")
+    t._create_net()  # must not raise
+
+
+def test_unused_block_divergence_ignored_for_other_task():
+    """task=pred never instantiates eval iterators; a divergent eval
+    block must not abort a prediction run."""
+    t = _mk_task(_DAUG_CONF_HEAD + """
+pred = out.txt
+iter = mnist
+iter = end
+eval = test
+iter = mnist
+  scale = 0.5
+iter = end
+""", task="pred")
+    t._create_net()  # must not raise
+
+
+def test_block_only_device_augment_fails_loudly():
+    """device_augment=1 ONLY inside an eval block: the trainer compiles
+    WITHOUT the in-step augment while that iterator stages raw pixels -
+    silently garbage eval metrics; must raise instead."""
+    t = _mk_task("""
+netconfig=start
+layer[0->1] = flatten
+layer[1->2] = fullc
+  nhidden = 4
+layer[2->2] = softmax
+netconfig=end
+input_shape = 1,6,6
+batch_size = 4
+eta = 0.1
+data = train
+iter = mnist
+iter = end
+eval = test
+iter = mnist
+  device_augment = 1
+iter = end
+""")
+    with pytest.raises(ValueError, match="device_augment mismatch"):
+        t._create_net()
+
+
+def test_pred_block_keys_do_not_clobber_train_net():
+    """Iterator-scoped pred-block keys (batch_size) must not reach the
+    trainer under task=train - the loss scale is 1/(batch_size *
+    update_period), so a clobber silently mis-scales gradients."""
+    t = _mk_task(_DAUG_CONF_HEAD + """
+data = train
+iter = mnist
+iter = end
+pred = out.txt
+iter = mnist
+  batch_size = 100
+iter = end
+""")
+    net = t._create_net()
+    assert net.batch_size == 4
+
+
+def test_pred_block_omitting_daug_key_ok_under_train():
+    """Under task=train the pred iterator is never instantiated; a
+    pred block that merely OMITS a data-block daug key must not abort
+    training (the compiled spec is correct)."""
+    t = _mk_task(_DAUG_CONF_HEAD + """
+data = train
+iter = mnist
+  divideby = 256
+iter = end
+pred = out.txt
+iter = mnist
+iter = end
+""")
+    t._create_net()  # must not raise
