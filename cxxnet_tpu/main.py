@@ -190,6 +190,12 @@ class LearnTask:
                 spec["scale"] = 1.0 / float(v)
             elif k == "scale":
                 spec["scale"] = float(v)
+            elif k == "mean_value":
+                # parse so `0, 0, 0` == `0,0,0`, and all-zero == OFF
+                # == absent (make_device_augment's own rule)
+                vals = tuple(float(t) for t in v.split(","))
+                spec[k] = "" if not any(vals) else \
+                    ",".join(f"{t:g}" for t in vals)
             elif k in spec:
                 spec[k] = v
         return spec

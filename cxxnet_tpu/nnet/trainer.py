@@ -46,6 +46,23 @@ from cxxnet_tpu.updater import UpdaterParam, create_updater
 from cxxnet_tpu.utils.metric import MetricSet
 
 
+def _bf16_cast(data: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 on the HOST, fast path via torch (~1.8x faster than
+    ml_dtypes on this class of host, bitwise identical round-to-
+    nearest-even - measured in round 4; an AlexNet b256 batch is ~40M
+    elements, so this cast sits on the e2e critical path)."""
+    import ml_dtypes
+    try:
+        import torch
+        t = torch.from_numpy(np.ascontiguousarray(data))
+        # AttributeError: torch.uint16 needs torch >= 2.3 - an older
+        # torch must fall back, not crash the staging path
+        return (t.to(torch.bfloat16).view(torch.uint16).numpy()
+                .view(ml_dtypes.bfloat16))
+    except (ImportError, AttributeError):
+        return data.astype(ml_dtypes.bfloat16)
+
+
 class NetTrainer:
     """Config-driven trainer for one network."""
 
@@ -362,8 +379,7 @@ class NetTrainer:
             # integer-valued pixels <= 256). copy=False: an
             # already-f32 batch must not pay a 150 MB memcpy
             return data.astype(np.float32, copy=False)
-        import ml_dtypes
-        return data.astype(ml_dtypes.bfloat16)
+        return _bf16_cast(data)
 
     def _compile(self) -> None:
         net = self.net

@@ -576,3 +576,20 @@ def test_checkpoint_slash_in_layer_name_and_corruption():
     bad[8:16] = (1 << 60).to_bytes(8, "little")
     with pytest.raises(ValueError, match="header length"):
         ckpt.load_model(io.BytesIO(bytes(bad)))
+
+
+def test_fast_bf16_cast_bitwise_matches_ml_dtypes():
+    """The torch fast path of the host bf16 staging cast must be
+    bitwise round-to-nearest-even identical to ml_dtypes (it sits on
+    the e2e critical path; a semantic drift would silently change
+    every staged batch)."""
+    import ml_dtypes
+    from cxxnet_tpu.nnet.trainer import _bf16_cast
+    rng = np.random.RandomState(0)
+    x = np.concatenate([
+        rng.randn(1000).astype(np.float32) * 1e3,
+        np.array([0.0, -0.0, 1e-40, np.inf, -np.inf], np.float32),
+    ])
+    a = _bf16_cast(x).view(np.uint16)
+    b = x.astype(ml_dtypes.bfloat16).view(np.uint16)
+    np.testing.assert_array_equal(a, b)
