@@ -4,7 +4,9 @@ Behavior parity with CXXNetLearnTask (src/cxxnet_main.cpp:16-478):
 
     python -m cxxnet_tpu.main <config.conf> [k=v ...]
 
-- tasks: train (default) / finetune / pred / extract
+- tasks: train (default) / finetune / pred / pred_raw / extract
+  (pred_raw: raw top-node rows - the reference accepts the task name
+  but never dispatches it, cxxnet_main.cpp:77-79 vs :242)
 - `continue = 1` resumes from the newest `model_dir/%04d.model`
 - per-round checkpoints gated by `save_model` period
 - eval metrics printed per round to stderr as
@@ -87,6 +89,8 @@ class LearnTask:
             self.task_train()
         elif self.task == "pred":
             self.task_predict()
+        elif self.task == "pred_raw":
+            self.task_predict_raw()
         elif self.task == "extract":
             self.task_extract_feature()
         else:
@@ -212,7 +216,7 @@ class LearnTask:
         erase the train block's crop)."""
         defcfg, train, evals, pred = self._split_blocks()
         feed = defcfg + (train or [])
-        if self.task in ("pred", "extract"):
+        if self.task in ("pred", "pred_raw", "extract"):
             feed = feed + (pred or [])
         net = NetTrainer()
         for k, v in feed:
@@ -231,7 +235,7 @@ class LearnTask:
         what _create_net fed the trainer, so eff IS the compiled
         spec."""
         active = []
-        if self.task in ("pred", "extract"):
+        if self.task in ("pred", "pred_raw", "extract"):
             if pred is not None:
                 active.append(("pred", pred))
         else:
@@ -341,7 +345,7 @@ class LearnTask:
     # ------------------------------------------------------------------
     def _create_iterators(self) -> None:
         defcfg, train, evals, pred = self._split_blocks()
-        if self.task in ("pred", "extract"):
+        if self.task in ("pred", "pred_raw", "extract"):
             if pred is not None:
                 self.itr_pred = create_iterator(pred)
         else:
@@ -448,6 +452,27 @@ class LearnTask:
                 pred = self.net_trainer.predict(batch)
                 for v in pred:
                     fo.write(f"{v:g}\n")
+        print(f"finished prediction, write into {self.name_pred}")
+
+    def task_predict_raw(self) -> None:
+        """task=pred_raw: one line of raw top-node outputs (e.g. the
+        full softmax probability row) per instance. The reference
+        ACCEPTS this task when wiring iterators (cxxnet_main.cpp:242)
+        but never dispatches it (:77-79), so its shipped
+        kaggle_bowl/pred.conf silently did nothing; here it does what
+        that conf intended."""
+        assert self.itr_pred is not None, \
+            "must specify a predict iterator to generate predictions"
+        print("start predicting...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                # padding rows already trimmed (_forward_nodes keeps
+                # mask.sum() rows, the reference's num_batch_padd trim)
+                flat = self.net_trainer.predict_dist(batch)
+                for row in flat:
+                    fo.write(" ".join(f"{v:g}" for v in row) + "\n")
         print(f"finished prediction, write into {self.name_pred}")
 
     def task_extract_feature(self) -> None:

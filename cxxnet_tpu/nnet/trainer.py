@@ -55,11 +55,13 @@ def _bf16_cast(data: np.ndarray) -> np.ndarray:
     try:
         import torch
         t = torch.from_numpy(np.ascontiguousarray(data))
-        # AttributeError: torch.uint16 needs torch >= 2.3 - an older
-        # torch must fall back, not crash the staging path
+        # AttributeError: torch.uint16 needs torch >= 2.3;
+        # RuntimeError: torch built against numpy 1.x under numpy 2.x
+        # ("Numpy is not available") - any such host must fall back,
+        # not crash the staging path
         return (t.to(torch.bfloat16).view(torch.uint16).numpy()
                 .view(ml_dtypes.bfloat16))
-    except (ImportError, AttributeError):
+    except (ImportError, AttributeError, RuntimeError):
         return data.astype(ml_dtypes.bfloat16)
 
 

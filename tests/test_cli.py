@@ -201,3 +201,35 @@ def test_cli_test_io(dataset, capfd):
     LearnTask().run([conf, "test_io=1", "num_round=1"])
     out = capfd.readouterr().out
     assert "I/O test" in out
+
+
+def test_cli_pred_raw_task(dataset):
+    """task=pred_raw writes one row of raw top-node outputs (the full
+    softmax probability vector) per instance. The reference accepts
+    this task when wiring iterators but never dispatches it
+    (cxxnet_main.cpp:77-79 vs :242) - here it does what its
+    kaggle_bowl/pred.conf intended: rows sum to 1 and argmax matches
+    task=pred."""
+    tmp_path, conf = dataset
+    LearnTask().run([conf, "num_round=3"])
+    raw_file = str(tmp_path / "raw.txt")
+    te_img, te_lbl = (str(tmp_path / "test-img.gz"),
+                      str(tmp_path / "test-lbl.gz"))
+    with open(conf, "a") as f:
+        f.write(f"""
+pred = {raw_file}
+iter = mnist
+    path_img = "{te_img}"
+    path_label = "{te_lbl}"
+iter = end
+""")
+    LearnTask().run([conf, "task=pred_raw",
+                     f"model_in={tmp_path}/models/0003.model"])
+    rows = np.loadtxt(raw_file)
+    assert rows.shape == (64, 3)
+    np.testing.assert_allclose(rows.sum(axis=1), 1.0, atol=1e-4)
+    pred_file = str(tmp_path / "pred2.txt")
+    LearnTask().run([conf, "task=pred", f"pred={pred_file}",
+                     f"model_in={tmp_path}/models/0003.model"])
+    np.testing.assert_array_equal(rows.argmax(axis=1),
+                                  np.loadtxt(pred_file))
