@@ -263,10 +263,36 @@ class ConvolutionLayer(Layer):
 # ---------------------------------------------------------------------------
 
 class PoolingLayer(Layer):
-    """max/sum/avg pooling (src/layer/pooling_layer-inl.hpp:17-114)."""
+    """max/sum/avg pooling (src/layer/pooling_layer-inl.hpp:17-114).
+
+    `pool_grad = winner` opts max pooling into XLA's native
+    single-winner backward instead of the reference's tie-duplicating
+    unpool rule - a documented semantics change on tied windows
+    (ops/pooling.py pool2d docstring)."""
 
     mode = "max"
     pre_relu = False
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.grad_mode = "ties"
+
+    def _winner_ok(self) -> bool:
+        """winner mode only exists for the max backward; accepting it
+        elsewhere would silently run the tie rule anyway."""
+        return self.mode == "max"
+
+    def set_param(self, name: str, val: str) -> None:
+        super().set_param(name, val)
+        if name == "pool_grad":
+            if val not in ("ties", "winner"):
+                raise ValueError(
+                    f"pool_grad must be 'ties' or 'winner', got {val!r}")
+            if val == "winner" and not self._winner_ok():
+                raise ValueError(
+                    f"pool_grad=winner is a max-pool backward option; "
+                    f"'{self.type_name}' has no single-winner rule")
+            self.grad_mode = val
 
     def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
         self.check_one_to_one(in_shapes)
@@ -291,7 +317,8 @@ class PoolingLayer(Layer):
             x = ops.relu(x)
         p = self.param
         return [ops.pool2d(x, self.mode, p.kernel_height, p.kernel_width,
-                           p.stride, p.pad_y, p.pad_x)]
+                           p.stride, p.pad_y, p.pad_x,
+                           grad_mode=self.grad_mode)]
 
 
 @register_layer
@@ -328,6 +355,12 @@ class InsanityPoolingLayer(PoolingLayer):
 
     type_name = "insanity_max_pooling"
     mode = "max"
+
+    def _winner_ok(self) -> bool:
+        # the displaced-read backward is defined by the tie-duplicating
+        # slot rule (ops/pooling.py insanity_pool2d); there is no
+        # single-winner variant to opt into
+        return False
 
     def __init__(self, name: str = ""):
         super().__init__(name)

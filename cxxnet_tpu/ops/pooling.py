@@ -46,18 +46,36 @@ def _pool_padding(in_dim: int, ksize: int, stride: int, pad: int) -> int:
 
 
 def pool2d(x: jax.Array, mode: str, ksize_y: int, ksize_x: int,
-           stride: int, pad_y: int = 0, pad_x: int = 0) -> jax.Array:
+           stride: int, pad_y: int = 0, pad_x: int = 0,
+           grad_mode: str = "ties") -> jax.Array:
     """Pool an NCHW tensor. mode in {'max', 'sum', 'avg'}.
 
     pad_y/pad_x symmetrically pad before pooling (inception-style
     same-size pooling); padding is neutral for the reducer (-inf for
     max, 0 for sum/avg) and avg still divides by the full window size.
+
+    grad_mode (max pooling only): 'ties' (default) is the reference's
+    unpool rule - every source equal to the window max receives the
+    full gradient (see module docstring). 'winner' opts into XLA's
+    native reduce_window-max gradient (select_and_scatter: one winner
+    per window, the cuDNN-style rule) - a DOCUMENTED semantics change
+    on tied windows, exposed as `pool_grad = winner` for workloads
+    where the bwd's ky*kx shifted-compare traffic shows up in the
+    profile and exact mshadow tie parity is not required.
     """
+    if grad_mode not in ("ties", "winner"):
+        raise ValueError(f"unknown grad_mode {grad_mode!r}")
     hi_y = _pool_padding(x.shape[2], ksize_y, stride, pad_y)
     hi_x = _pool_padding(x.shape[3], ksize_x, stride, pad_x)
     if mode == "max":
-        out = max_pool2d(x, ksize_y, ksize_x, stride, pad_y, pad_x,
-                         hi_y, hi_x)
+        if grad_mode == "winner":
+            out = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 1, ksize_y, ksize_x),
+                (1, 1, stride, stride),
+                ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x)))
+        else:
+            out = max_pool2d(x, ksize_y, ksize_x, stride, pad_y, pad_x,
+                             hi_y, hi_x)
     elif mode in ("sum", "avg"):
         out = lax.reduce_window(
             x, 0.0, lax.add, (1, 1, ksize_y, ksize_x),

@@ -137,3 +137,53 @@ def test_insanity_pool_backward_credits_slot_positions():
         P.pool2d(v, "max", 2, 2, 2)))(jittered)
     np.testing.assert_allclose(np.asarray(g), np.asarray(expected),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_pool_grad_winner_mode():
+    """pool_grad=winner: XLA's native single-winner backward. Forward
+    identical to the default; backward assigns each window's gradient
+    to exactly ONE tied source (sum preserved), where the reference
+    'ties' rule duplicates it to all."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops.pooling import pool2d
+
+    x = jnp.asarray(np.full((1, 1, 2, 2), 3.0, np.float32))  # all tied
+
+    def loss(x, gm):
+        return pool2d(x, "max", 2, 2, 2, grad_mode=gm).sum()
+
+    np.testing.assert_array_equal(
+        np.asarray(pool2d(x, "max", 2, 2, 2, grad_mode="winner")),
+        np.asarray(pool2d(x, "max", 2, 2, 2)))
+    g_ties = np.asarray(jax.grad(loss)(x, "ties"))
+    g_win = np.asarray(jax.grad(loss)(x, "winner"))
+    np.testing.assert_array_equal(g_ties, np.ones((1, 1, 2, 2)))  # all 4
+    assert g_win.sum() == 1.0 and (g_win > 0).sum() == 1  # one winner
+
+
+def test_pool_grad_layer_key_validated():
+    import pytest
+    from cxxnet_tpu.layers.common import MaxPoolingLayer
+    lay = MaxPoolingLayer("p")
+    lay.set_param("pool_grad", "winner")
+    assert lay.grad_mode == "winner"
+    with pytest.raises(ValueError, match="pool_grad"):
+        lay.set_param("pool_grad", "both")
+
+
+def test_pool_grad_winner_rejected_off_max():
+    """pool_grad=winner on sum/avg/insanity pooling must raise - there
+    is no single-winner rule there and silently running the tie rule
+    would mislead the user."""
+    import pytest
+    from cxxnet_tpu.layers.common import (
+        AvgPoolingLayer, InsanityPoolingLayer)
+    for cls in (AvgPoolingLayer, InsanityPoolingLayer):
+        with pytest.raises(ValueError, match="pool_grad=winner"):
+            cls("p").set_param("pool_grad", "winner")
+    from cxxnet_tpu.ops.pooling import pool2d
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match="grad_mode"):
+        pool2d(jnp.zeros((1, 1, 4, 4)), "max", 2, 2, 2,
+               grad_mode="Winner")
