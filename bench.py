@@ -461,6 +461,24 @@ def _bench_googlenet(batch, steps, platform: str) -> dict:
         return {"googlenet_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_pool_winner(make, batch, steps, platform: str) -> dict:
+    """Compute-path throughput with `pool_grad = winner` (XLA's native
+    single-winner max-pool backward) vs the default reference
+    tie-duplicating rule - the flagship-level answer to whether the
+    tie rule's ky*kx shifted-compare HBM traffic is a real cost on
+    silicon (tools/bench_pool.py gives the per-shape view; CPU showed
+    winner 2.2-2.9x faster per pool). One extra compile; TPU only.
+    Disable with CXN_BENCH_POOLWINNER=0."""
+    if platform != "tpu" or os.environ.get("CXN_BENCH_POOLWINNER") == "0":
+        return {}
+    try:
+        tr = make(0, [("pool_grad", "winner")])
+        return {"compute_poolwinner_ips":
+                round(_measure_compute(tr, batch, steps), 2)}
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"pool_winner_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_eval_train(make, batch, steps) -> dict:
     """eval_train=1 (the reference's default mode): the conf's metric
     lines (error, rec@1, rec@5) compile into the step as device-side
@@ -479,19 +497,20 @@ def _bench_eval_train(make, batch, steps) -> dict:
         return {"eval_train_error": f"{type(e).__name__}: {e}"}
 
 
-def _setup_compile_cache() -> None:
+def _setup_compile_cache(platform: str = "") -> None:
     """Repo-local persistent XLA compile cache: AlexNet-sized TPU
     compiles cost 20-40 s each; the repo dir persists across rounds, so
     cached executables turn the watchdog budget into measurement time.
-    Keyed by platform/compiler fingerprint, so CPU smoke runs and TPU
-    bench runs coexist. Disable with CXN_BENCH_CACHE=0."""
-    if os.environ.get("CXN_BENCH_CACHE") == "0":
-        return
+    TPU entries live at the cache root (device-targeted, host-
+    independent). CPU entries are scoped per host-CPU fingerprint:
+    XLA:CPU AOT results baked for another machine's features load with
+    SIGILL warnings (seen round 4), and a bench crash is worse than a
+    recompile. Disable with CXN_BENCH_CACHE=0."""
     try:
-        from cxxnet_tpu.utils.platform import set_compilation_cache_dir
-        set_compilation_cache_dir(
-            os.environ.get("CXN_BENCH_CACHE_DIR",
-                           os.path.join(_REPO, ".jax_cache")))
+        from cxxnet_tpu.utils.platform import setup_scoped_cache
+        setup_scoped_cache(
+            platform, os.environ.get(
+                "CXN_BENCH_CACHE_DIR", os.path.join(_REPO, ".jax_cache")))
     except Exception as e:  # noqa: BLE001 - cache is an optimization
         sys.stderr.write(f"bench: compile cache unavailable: {e}\n")
 
@@ -506,7 +525,6 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     # possibly-dead tunnel (utils/platform.py)
     from cxxnet_tpu.utils.platform import ensure_env_platform
     ensure_env_platform()
-    _setup_compile_cache()
     # backend init is the one step that touches the (possibly tunneled)
     # platform - retry transient failures instead of dying rc=1
     last = None
@@ -520,6 +538,9 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     else:
         raise RuntimeError(f"jax backend unreachable: {last}")
     platform = devices[0].platform
+    # after backend init so the CPU cache can be host-scoped; the cache
+    # only has to be configured before the first compile
+    _setup_compile_cache(platform)
     ndev = len(devices)
     kind = getattr(devices[0], "device_kind", "") or ""
     peak_tflops = next((p for sub, p in _TPU_PEAK_TFLOPS
@@ -531,11 +552,12 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     batch = batch_override or (256 if platform != "cpu" else 8)
     steps = steps_override or (50 if platform != "cpu" else 2)
 
-    def make(eval_train):
+    def make(eval_train, extra=()):
         return _make_trainer(
             parse_config_file(_ALEXNET_CONF),
             [("batch_size", str(batch)), ("dev", "tpu"), ("silent", "1"),
-             ("eval_train", str(eval_train)), ("save_model", "0")])
+             ("eval_train", str(eval_train)), ("save_model", "0"),
+             *extra])
 
     trainer = make(0)
     out = {
@@ -605,6 +627,8 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     out.update(_bench_device_augment(batch, steps, platform))
     _snapshot(out)
     out.update(_bench_googlenet(batch, steps, platform))
+    _snapshot(out)
+    out.update(_bench_pool_winner(make, batch, steps, platform))
     _snapshot(out)
     out.update(_bench_input_split(trainer, batch, platform))
     _snapshot(out)

@@ -65,14 +65,18 @@ def pool2d(x: jax.Array, mode: str, ksize_y: int, ksize_x: int,
     """
     if grad_mode not in ("ties", "winner"):
         raise ValueError(f"unknown grad_mode {grad_mode!r}")
+    if grad_mode == "winner" and mode != "max":
+        # the layer guard rejects this too; enforce at the op so a
+        # direct caller can never believe it switched a backward rule
+        # that does not exist for sum/avg
+        raise ValueError("grad_mode='winner' only exists for max "
+                         "pooling")
     hi_y = _pool_padding(x.shape[2], ksize_y, stride, pad_y)
     hi_x = _pool_padding(x.shape[3], ksize_x, stride, pad_x)
     if mode == "max":
         if grad_mode == "winner":
-            out = lax.reduce_window(
-                x, -jnp.inf, lax.max, (1, 1, ksize_y, ksize_x),
-                (1, 1, stride, stride),
-                ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x)))
+            out = _reduce_max(x, ksize_y, ksize_x, stride,
+                              pad_y, pad_x, hi_y, hi_x)
         else:
             out = max_pool2d(x, ksize_y, ksize_x, stride, pad_y, pad_x,
                              hi_y, hi_x)
@@ -88,15 +92,21 @@ def pool2d(x: jax.Array, mode: str, ksize_y: int, ksize_x: int,
     return out
 
 
+def _reduce_max(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x):
+    """The ONE primal max reduce_window both backward modes share -
+    'winner' differentiates straight through it (select_and_scatter),
+    'ties' wraps it in the custom_vjp below; a padding-layout change
+    here changes both forwards together."""
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, ky, kx), (1, 1, stride, stride),
+        ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x)))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
 def max_pool2d(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x):
     """Max pooling with the reference's unpool backward (see module
     docstring). Padding args are precomputed by pool2d."""
-    window = (1, 1, ky, kx)
-    strides = (1, 1, stride, stride)
-    padding = ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x))
-    return lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
-                             padding)
+    return _reduce_max(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x)
 
 
 def _max_pool_fwd(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x):

@@ -66,20 +66,30 @@ def main(argv) -> int:
 
     from cxxnet_tpu.ops import pallas_attention as PA
     from cxxnet_tpu.ops.attention import blockwise_attention
-    from cxxnet_tpu.utils.platform import set_compilation_cache_dir
-    set_compilation_cache_dir(".jax_cache")
+    from cxxnet_tpu.utils.platform import setup_scoped_cache
+    setup_scoped_cache(jax.default_backend())
 
     b, h, s, d = shape
     rng = np.random.RandomState(0)
     q, k, v = (jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
                for _ in range(3))
     flops = 14.0 * b * h * s * s * d
+    # causal rows count REALIZED flops (~half: future tiles skipped)
+    # and compare against a causal XLA baseline - full-count causal
+    # numbers would overstate throughput ~2x and make vs_xla
+    # apples-to-oranges
+    flops_c = flops / 2.0
 
-    xla_tf, _ = measure(
-        lambda q, k, v: blockwise_attention(q, k, v, kv_block=512),
-        q, k, v, flops, steps)
-    print(json.dumps({"config": "xla_blockwise",
-                      "tflops": round(xla_tf, 2)}), flush=True)
+    baselines = {}
+    for causal in (False, True):
+        tf, _ = measure(
+            lambda q, k, v, c=causal: blockwise_attention(
+                q, k, v, kv_block=512, causal=c),
+            q, k, v, flops_c if causal else flops, steps)
+        baselines[causal] = tf
+        print(json.dumps({
+            "config": "xla_blockwise" + ("_causal" if causal else ""),
+            "tflops": round(tf, 2)}), flush=True)
 
     saved = PA.BLOCK_Q, PA.BLOCK_K
     try:
@@ -90,12 +100,12 @@ def main(argv) -> int:
                     tf, comp = measure(
                         lambda q, k, v: PA.flash_attention(
                             q, k, v, causal, None, False),
-                        q, k, v, flops, steps)
+                        q, k, v, flops_c if causal else flops, steps)
                     print(json.dumps({
                         "config": f"bq{bq}_bk{bk}" +
                                   ("_causal" if causal else ""),
                         "tflops": round(tf, 2),
-                        "vs_xla": round(tf / xla_tf, 3),
+                        "vs_xla": round(tf / baselines[causal], 3),
                         "compile_s": round(comp, 1)}), flush=True)
                 except Exception as e:  # noqa: BLE001 - sweep survives
                     print(json.dumps({

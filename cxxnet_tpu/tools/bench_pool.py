@@ -41,8 +41,8 @@ def main(argv) -> int:
     import jax.numpy as jnp
 
     from cxxnet_tpu.ops.pooling import pool2d
-    from cxxnet_tpu.utils.platform import set_compilation_cache_dir
-    set_compilation_cache_dir(".jax_cache")
+    from cxxnet_tpu.utils.platform import setup_scoped_cache
+    setup_scoped_cache(jax.default_backend())
 
     # (name, input shape, k, stride) — AlexNet's pools, default b256
     shapes = [("pool1", (batch, 96, 55, 55), 3, 2),

@@ -23,6 +23,38 @@ def ensure_env_platform() -> None:
         pass  # backend already initialized
 
 
+def setup_scoped_cache(platform_name: str, base: str = "") -> None:
+    """Persistent-compile-cache setup shared by bench.py and the
+    kernel-tuning tools: honors CXN_BENCH_CACHE=0 / CXN_BENCH_CACHE_DIR,
+    keeps TPU entries at the cache root (device-targeted, host-
+    independent), and scopes CPU entries per host-CPU fingerprint -
+    XLA:CPU AOT results baked for another machine's features load with
+    SIGILL warnings (seen round 4). With no fingerprint available the
+    CPU cache is skipped entirely: a cold compile beats a crash."""
+    if os.environ.get("CXN_BENCH_CACHE") == "0":
+        return
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    base = (base or os.environ.get("CXN_BENCH_CACHE_DIR")
+            or os.path.join(repo, ".jax_cache"))
+    if platform_name == "cpu":
+        import hashlib
+        fp = ""
+        try:
+            with open("/proc/cpuinfo") as f:
+                fp = next((ln for ln in f if ln.startswith("flags")), "")
+        except OSError:
+            pass
+        if not fp:
+            import platform as _plat
+            fp = _plat.machine() + _plat.processor()
+        if not fp:
+            return
+        base = os.path.join(
+            base, "cpu-" + hashlib.md5(fp.encode()).hexdigest()[:10])
+    set_compilation_cache_dir(base)
+
+
 def set_compilation_cache_dir(path: str) -> None:
     """Point XLA's persistent compilation cache at `path` (and make
     tiny/fast compiles eligible, so tests can observe it).
