@@ -461,6 +461,46 @@ def _bench_googlenet(batch, steps, platform: str) -> dict:
         return {"googlenet_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_chip_matmul(platform: str) -> dict:
+    """Pure-matmul sustained TFLOP/s: 64 chained 4096^2 bf16 matmuls
+    inside ONE jitted lax.scan, so per-call dispatch latency (measured
+    ~3.3 ms through the tunnel - longer than the matmul itself)
+    cannot bound the number. Grounds the MFU story: if the chip
+    sustains near its spec peak here but AlexNet's step runs far
+    below, the gap is model-shape-bound (conv1 11x11/s4, LRN, pools),
+    not a chip or runtime artifact. TPU only; no readbacks. Disable
+    with CXN_BENCH_MATMUL=0."""
+    if platform != "tpu" or os.environ.get("CXN_BENCH_MATMUL") == "0":
+        return {}
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        n, chain = 4096, 64
+
+        def body(x, _):
+            return (x @ x) * (1.0 / n), None
+
+        @jax.jit
+        def run(x):
+            y, _ = lax.scan(body, x, None, length=chain)
+            return y
+
+        x = jnp.full((n, n), 1.0, jnp.bfloat16)
+        jax.block_until_ready(run(x))
+        reps = 5
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(reps):
+            y = run(y)
+        jax.block_until_ready(y)
+        dt = time.perf_counter() - t0
+        tflops = reps * chain * 2.0 * n ** 3 / dt / 1e12
+        return {"chip_matmul_tflops": round(tflops, 1)}
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"matmul_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_pool_winner(make, batch, steps, platform: str) -> dict:
     """Compute-path throughput with `pool_grad = winner` (XLA's native
     single-winner max-pool backward) vs the default reference
@@ -508,9 +548,7 @@ def _setup_compile_cache(platform: str = "") -> None:
     recompile. Disable with CXN_BENCH_CACHE=0."""
     try:
         from cxxnet_tpu.utils.platform import setup_scoped_cache
-        setup_scoped_cache(
-            platform, os.environ.get(
-                "CXN_BENCH_CACHE_DIR", os.path.join(_REPO, ".jax_cache")))
+        setup_scoped_cache(platform)
     except Exception as e:  # noqa: BLE001 - cache is an optimization
         sys.stderr.write(f"bench: compile cache unavailable: {e}\n")
 
@@ -629,6 +667,8 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     out.update(_bench_googlenet(batch, steps, platform))
     _snapshot(out)
     out.update(_bench_pool_winner(make, batch, steps, platform))
+    _snapshot(out)
+    out.update(_bench_chip_matmul(platform))
     _snapshot(out)
     out.update(_bench_input_split(trainer, batch, platform))
     _snapshot(out)

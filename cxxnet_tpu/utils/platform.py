@@ -42,12 +42,15 @@ def setup_scoped_cache(platform_name: str, base: str = "") -> None:
         fp = ""
         try:
             with open("/proc/cpuinfo") as f:
-                fp = next((ln for ln in f if ln.startswith("flags")), "")
+                # x86 lists ISA extensions under "flags", ARM under
+                # "Features"; anything else is NO fingerprint - a
+                # machine()-style fallback would be near-constant
+                # across hosts with different ISA features, silently
+                # re-creating the cross-host SIGILL hazard
+                fp = next((ln for ln in f
+                           if ln.startswith(("flags", "Features"))), "")
         except OSError:
             pass
-        if not fp:
-            import platform as _plat
-            fp = _plat.machine() + _plat.processor()
         if not fp:
             return
         base = os.path.join(
