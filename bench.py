@@ -553,6 +553,62 @@ def _setup_compile_cache(platform: str = "") -> None:
         sys.stderr.write(f"bench: compile cache unavailable: {e}\n")
 
 
+def _reexec_cpu(reason: str) -> None:
+    """Re-exec this process onto the CPU backend (the only escape from
+    a PJRT client init hanging in C with signals undeliverable). On
+    execve failure it RETURNS (with a stderr note) so the caller can
+    fall through to its own degradation path."""
+    sys.stderr.write(f"bench: {reason}; re-exec on CPU\n")
+    sys.stderr.flush()
+    prior = os.environ.get("JAX_PLATFORMS", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", CXN_BENCH_FALLBACK="1",
+               CXN_BENCH_FALLBACK_FROM=prior or "default")
+    try:
+        os.execve(sys.executable,
+                  [sys.executable, _BENCH_PATH] + sys.argv[1:], env)
+    except OSError as e:
+        sys.stderr.write(f"bench: re-exec failed: {e}\n")
+
+
+def _probe_backend_or_reexec() -> None:
+    """90 s SUBPROCESS probe of backend init before this process
+    commits to it. A wedged tunnel hangs PJRT client creation
+    unkillably (observed round 4: hung for hours); without the probe
+    the watchdog burns its whole budget discovering that, leaving the
+    CPU fallback to start with nothing. The probe child can be
+    killed, so a dead tunnel costs ~90 s instead of the full budget.
+    A healthy tunnel costs one extra client init (~10 s). Skipped on
+    the fallback run and under an explicit cpu platform. Disable with
+    CXN_BENCH_PROBE=0."""
+    if (os.environ.get("CXN_BENCH_PROBE") == "0"
+            or os.environ.get("CXN_BENCH_FALLBACK") == "1"
+            or os.environ.get("JAX_PLATFORMS", "") == "cpu"):
+        return
+    import subprocess
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c",
+             "from cxxnet_tpu.utils.platform import ensure_env_platform;"
+             "ensure_env_platform();"
+             "import jax; jax.devices()"],
+            timeout=float(os.environ.get("CXN_BENCH_PROBE_S", "90")),
+            cwd=_REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL).returncode
+    except subprocess.TimeoutExpired:
+        _reexec_cpu("backend probe hung (wedged tunnel?)")
+        # reached only when the re-exec failed: proceed on the original
+        # backend and let the in-process retry + watchdog degrade
+        return
+    except Exception as e:  # noqa: BLE001 - probe is best-effort
+        sys.stderr.write(f"bench: backend probe skipped: {e}\n")
+        return
+    if rc != 0:
+        # init ERRORS (not hangs) are retried in-process by run();
+        # don't fall back on a possibly-transient failure
+        sys.stderr.write(f"bench: backend probe exited rc={rc}; "
+                         "proceeding (in-process retry)\n")
+
+
 def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     import jax
     from __graft_entry__ import _ALEXNET_CONF, _make_trainer
@@ -563,6 +619,7 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     # possibly-dead tunnel (utils/platform.py)
     from cxxnet_tpu.utils.platform import ensure_env_platform
     ensure_env_platform()
+    _probe_backend_or_reexec()
     # backend init is the one step that touches the (possibly tunneled)
     # platform - retry transient failures instead of dying rc=1
     last = None
@@ -720,19 +777,9 @@ def main(argv) -> int:
                     {k: v for k, v in _PARTIAL.items()
                      if k != "emitted"}), flush=True)
                 os._exit(0)
-        prior = os.environ.get("JAX_PLATFORMS", "")
-        if os.environ.get("CXN_BENCH_FALLBACK") != "1" and prior != "cpu":
-            sys.stderr.write(
-                f"bench: backend hung for {budget}s; re-exec on CPU\n")
-            sys.stderr.flush()
-            env = dict(os.environ, JAX_PLATFORMS="cpu",
-                       CXN_BENCH_FALLBACK="1",
-                       CXN_BENCH_FALLBACK_FROM=prior or "default")
-            try:
-                os.execve(sys.executable,
-                          [sys.executable, _BENCH_PATH] + argv, env)
-            except OSError as e:
-                sys.stderr.write(f"bench: re-exec failed: {e}\n")
+        if (os.environ.get("CXN_BENCH_FALLBACK") != "1"
+                and os.environ.get("JAX_PLATFORMS", "") != "cpu"):
+            _reexec_cpu(f"backend hung for {budget}s")
         print(_error_json(f"benchmark exceeded {budget}s "
                           "(hung backend / stuck tunnel?)"), flush=True)
         os._exit(0)
