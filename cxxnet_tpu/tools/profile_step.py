@@ -48,8 +48,8 @@ def capture(trace_dir: str, steps: int = 20) -> str:
         parse_config_file(_ALEXNET_CONF),
         [("batch_size", str(batch)), ("dev", "tpu"), ("silent", "1"),
          ("eval_train", "0"), ("save_model", "0")])
-    ips = bench._measure_e2e(trainer, batch, steps, trace_dir)
-    print(f"traced {steps} steps at {ips:.1f} images/sec")
+    ips, n = bench._measure_e2e(trainer, batch, steps, trace_dir)
+    print(f"traced {n} steps at {ips:.1f} images/sec")
 
     paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                       recursive=True)

@@ -10,11 +10,14 @@ or the extras plumbing fails the suite, not the round.
 """
 
 import json
+import os
 import subprocess
 import sys
 
 import numpy as np
 import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_bench_run_end_to_end(monkeypatch, tmp_path):
@@ -98,6 +101,97 @@ def test_bench_device_augment_extra_runs(monkeypatch, tmp_path):
     import bench
     out = bench._bench_device_augment(4, 1, "tpu")
     assert out.get("device_augment_ips", 0) > 0, out
+
+
+def test_physics_check_retracts_impossible_numbers():
+    """A field whose implied FLOP/s exceeds 1.25x the chip's spec peak
+    is dispatch timing from a window where no sync primitive worked
+    (round-4 on-chip: 206k img/s 'compute', 355,311 TFLOP/s 'matmul');
+    the artifact must carry it as *_implausible, never as a result."""
+    import bench
+    out = {"compute_ips": 206825.51, "e2e_ips": 250.0,
+           "chip_matmul_tflops": 355311.6,
+           "attn_pallas_tflops": 39893.5, "attn_xla_tflops": 28606.0,
+           "attn_pallas_speedup": 1.395,
+           "googlenet_ips": 2198.0}
+    bench._physics_check(out, 197.0, 1)
+    assert "compute_ips" not in out
+    assert out["compute_ips_implausible"] == 206825.51
+    assert "chip_matmul_tflops" not in out
+    # the ratio of two dispatch timings must go with its inputs
+    assert "attn_pallas_speedup" not in out
+    # plausible numbers survive untouched
+    assert out["e2e_ips"] == 250.0
+    assert out["googlenet_ips"] == 2198.0
+
+
+def test_physics_check_keeps_real_on_chip_numbers():
+    """The caps must never flag genuinely measured values (the real
+    round-4 artifact: 13.6k img/s winner compute, 147 TFLOP/s chained
+    matmul on a 197-peak v5e)."""
+    import bench
+    out = {"compute_ips": 13579.82, "e2e_ips": 1140.7,
+           "chip_matmul_tflops": 147.2, "attn_pallas_tflops": 13.31,
+           "attn_xla_tflops": 14.67, "attn_pallas_speedup": 0.907}
+    before = dict(out)
+    bench._physics_check(out, 197.0, 1)
+    assert out == before
+
+
+def test_derive_relabels_headline_and_drops_stale_ratio():
+    """_derive must label the artifact by its best available number and
+    retract derived ratios whose inputs a physics check removed."""
+    import bench
+    out = {"compute_ips": 7402.0}
+    bench._derive(out, 256, "tpu", 1, 197.0)
+    assert out["value"] == 7402.0 and out["value_is"] == "compute_only"
+    assert out["metric"] == "alexnet_b256_tpu_train_compute"
+    out["e2e_ips"] = 1140.0
+    bench._derive(out, 256, "tpu", 1, 197.0)
+    assert out["value"] == 1140.0 and out["value_is"] == "e2e"
+    assert out["e2e_over_compute"] == pytest.approx(1140.0 / 7402.0,
+                                                    rel=1e-3)
+    # now a (simulated) physics check retracts compute
+    out.pop("compute_ips")
+    bench._derive(out, 256, "tpu", 1, 197.0)
+    assert "e2e_over_compute" not in out
+    assert out["value_is"] == "e2e"
+
+
+def test_derive_estimates_device_step_in_readback_mode():
+    """When the profiled device step is unavailable (readback sync),
+    the host/device split is derived from compute_ips and marked est."""
+    import bench
+    out = {"compute_ips": 10000.0, "host_prep_ms_p50": 128.0}
+    bench._derive(out, 256, "tpu", 1, 197.0)
+    assert out["device_step_ms_est"] == pytest.approx(25.6)
+    assert out["host_over_device"] == pytest.approx(5.0)
+
+
+def test_run_isolated_wraps_failures(monkeypatch):
+    """A child that dies or hangs must degrade to a *_error field."""
+    import bench
+    # pin the child to CPU: on a TPU-attached host the child would
+    # otherwise initialize the (possibly wedged) tunnel backend before
+    # hitting the unknown-name KeyError
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    frag = bench._run_isolated("no_such_measurement", 4, 1, "", 120)
+    assert "no_such_measurement_error" in frag
+
+
+def test_child_only_mode_emits_fragment(tmp_path, monkeypatch):
+    """python bench.py --only NAME prints exactly one JSON fragment."""
+    import subprocess
+    import sys as _sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CXN_BENCH_CACHE_DIR=str(tmp_path / "cache"))
+    r = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "bench.py"),
+         "--only", "compute", "--steps", "1", "--batch", "4"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-500:]
+    frag = json.loads(r.stdout.strip().splitlines()[-1])
+    assert frag["compute_ips"] > 0
 
 
 def test_bench_error_artifact_is_json():
