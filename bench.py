@@ -123,6 +123,23 @@ def _default_workload(platform: str, batch: int, steps: int):
 _PARTIAL: dict = {}
 _EMIT_LOCK = threading.Lock()
 
+# the isolated-measurement child currently in flight, if any: the
+# watchdog must kill it before os._exit - an orphaned child (spawned
+# with CXN_BENCH_TIMEOUT=0, no parent left to enforce its timeout)
+# wedged inside PJRT would hold the exclusive TPU forever. Spawn and
+# kill are serialized under _EMIT_LOCK with _SHUTTING_DOWN so the
+# main thread cannot spawn child B while the watchdog is between
+# killing child A and exiting (B would be exactly such an orphan).
+_CURRENT_CHILD = None
+_SHUTTING_DOWN = False
+
+# absolute monotonic instant the watchdog will fire, set by main()
+# the moment it starts the Timer so run()'s isolation deadline and
+# the watchdog share ONE clock (anchoring the deadline inside run()
+# would silently donate the backend probe / PJRT init / calibration
+# time - up to ~2 min - to the margin and race the watchdog)
+_WATCHDOG_FIRE_AT = float("inf")
+
 
 def _snapshot(out: dict) -> None:
     """Checkpoint the result dict so the watchdog can emit it as-is.
@@ -150,20 +167,28 @@ def _snapshot(out: dict) -> None:
 _SYNC_MODE = "block"
 
 
+def _readback_sync(x):
+    """The readback sync primitive, shared with the tool modules
+    (cxxnet_tpu.tools.bench_attn imports it): fetching ONE element of
+    the last leaf forces the whole dispatched execution to complete
+    (PJRT finishes an executable's outputs as a unit); bytes moved: 1
+    element. Correct in every observed tunnel window, but stickily
+    poisons the process's H2D - time its placement accordingly."""
+    import jax
+    import jax.numpy as jnp
+    leaves = [l for l in jax.tree_util.tree_leaves(x)
+              if hasattr(l, "dtype") and getattr(l, "size", 0)]
+    if leaves:
+        np.asarray(jnp.ravel(leaves[-1])[0])
+    return x
+
+
 def _sync(x):
     """Wait until the computation producing pytree ``x`` has finished."""
     import jax
     if _SYNC_MODE != "readback":
         return jax.block_until_ready(x)
-    import jax.numpy as jnp
-    leaves = [l for l in jax.tree_util.tree_leaves(x)
-              if hasattr(l, "dtype") and getattr(l, "size", 0)]
-    if leaves:
-        # fetching ONE element of the last output forces the whole
-        # dispatched execution to complete (PJRT finishes an
-        # executable's outputs as a unit); bytes moved: 1 element
-        np.asarray(jnp.ravel(leaves[-1])[0])
-    return x
+    return _readback_sync(x)
 
 
 def _warm_sync(x):
@@ -957,17 +982,31 @@ def _run_isolated(name: str, batch: int, steps: int, profile_dir: str,
     # within a boot, so each child re-calibrates for its own window
     # (an explicit user-set CXN_BENCH_SYNC is inherited via os.environ)
     env = dict(os.environ, CXN_BENCH_PROBE="0", CXN_BENCH_TIMEOUT="0")
+    global _CURRENT_CHILD
     try:
-        r = subprocess.run(cmd, cwd=_REPO, capture_output=True,
-                           text=True, timeout=timeout_s, env=env)
-        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() \
-            else ""
-        if r.returncode == 0 and line:
+        with _EMIT_LOCK:
+            # spawn under the lock: the watchdog sets _SHUTTING_DOWN
+            # and kills the current child under the same lock, so a
+            # child can never be spawned into a dying parent
+            if _SHUTTING_DOWN:
+                return {f"{name}_error": "skipped: parent shutting down"}
+            p = subprocess.Popen(cmd, cwd=_REPO, env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True)
+            _CURRENT_CHILD = p  # so the watchdog can kill it on exit
+        try:
+            stdout, stderr = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            return {f"{name}_error": f"timed out after {timeout_s}s"}
+        finally:
+            _CURRENT_CHILD = None
+        line = stdout.strip().splitlines()[-1] if stdout.strip() else ""
+        if p.returncode == 0 and line:
             return json.loads(line)
         return {f"{name}_error":
-                f"rc={r.returncode}: {r.stderr[-300:].strip()}"}
-    except subprocess.TimeoutExpired:
-        return {f"{name}_error": f"timed out after {timeout_s}s"}
+                f"rc={p.returncode}: {stderr[-300:].strip()}"}
     except Exception as e:  # noqa: BLE001 - isolation is containment
         return {f"{name}_error": f"{type(e).__name__}: {e}"}
 
@@ -1156,11 +1195,34 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
                and os.environ.get("CXN_BENCH_ISOLATE", "1") != "0"
                and os.environ.get("CXN_BENCH_FALLBACK") != "1")
     if isolate:
+        # live within the WATCHDOG's budget, don't race it: the child
+        # timeouts sum to ~3x the default 480s, so each child's
+        # timeout is capped to the time remaining (minus a margin for
+        # the final print) and the tail is skipped outright when the
+        # margin is gone. The parent then always exits cleanly with a
+        # best-so-far artifact instead of the watchdog re-exec'ing a
+        # half-finished TPU run onto the CPU. The deadline shares the
+        # watchdog Timer's own anchor (main() sets _WATCHDOG_FIRE_AT
+        # when it starts the Timer) - anchoring here would donate the
+        # backend probe / PJRT init / calibration time to the margin.
+        if _WATCHDOG_FIRE_AT != float("inf"):
+            deadline = _WATCHDOG_FIRE_AT - 25.0
+        else:  # run() called directly (tests, library use): no Timer
+            budget = float(os.environ.get("CXN_BENCH_TIMEOUT", "480"))
+            deadline = (time.monotonic() + budget - 25.0) if budget > 0 \
+                else float("inf")
         for name, _fn, _gate, tmo, _kind in _MEASUREMENTS:
             if name in gates_off:
                 continue
+            remaining = deadline - time.monotonic()
+            if remaining < 30.0:
+                out.setdefault(
+                    "truncated",
+                    f"isolated tail from '{name}' skipped: watchdog "
+                    "budget exhausted")
+                break
             out.update(_run_isolated(name, batch, steps, profile_dir,
-                                     tmo))
+                                     min(tmo, remaining)))
             _physics_check(out, peak_tflops, ndev)
             _derive(out, batch, platform, ndev, peak_tflops)
             _snapshot(out)
@@ -1168,7 +1230,12 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
         # boot: 236 img/s in one window, 1,140 in another, same code);
         # a second run at the end takes the better window and records
         # both, so one bad window cannot misprice the framework
-        frag2 = _run_isolated("e2e", batch, steps, "", 200)
+        remaining = deadline - time.monotonic()
+        if remaining < 30.0:
+            frag2 = {}
+        else:
+            frag2 = _run_isolated("e2e", batch, steps, "",
+                                  min(200.0, remaining))
         # physics-check the fragment BEFORE promotion: a run2 from a
         # no-working-sync window must not overwrite run1's genuine
         # number only to be retracted afterwards
@@ -1179,11 +1246,14 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
             # verified-sync run beats an unverified one regardless of
             # magnitude (an unverified readback means the number may be
             # dispatch timing - inflated, not better)
-            def _quality(sync):
+            def _quality(frag_or_out):
+                # no number at all < unverified number < verified
+                if not frag_or_out.get("e2e_ips"):
+                    return -1
+                sync = frag_or_out.get("e2e_sync", "block")
                 return 0 if sync == "readback_unverified" else 1
-            q1 = (_quality(out.get("e2e_sync", "block")),
-                  out.get("e2e_ips", 0.0))
-            q2 = (_quality(frag2.get("e2e_sync", "block")), v2)
+            q1 = (_quality(out), out.get("e2e_ips", 0.0))
+            q2 = (_quality(frag2), v2)
             if q2 > q1:
                 # demote run1's fields (incl. a failure or a physics
                 # retraction), promote frag2 wholesale so every
@@ -1203,7 +1273,9 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
                         "see *_run1 fields), not the headline run")
             else:
                 out["e2e_ips_run2"] = v2
-                for k in ("h2d_mbps", "h2d_dispatch_mbps"):
+                # the sync annotation travels with the number: a
+                # losing run2 is often losing BECAUSE it is unverified
+                for k in ("e2e_sync", "h2d_mbps", "h2d_dispatch_mbps"):
                     if frag2.get(k):
                         out[k + "_run2"] = frag2[k]
         else:
@@ -1293,6 +1365,19 @@ def main(argv) -> int:
         # re-exec the whole process onto the CPU backend so the harness
         # still produces a real (clearly-labeled) number; second
         # occurrence: emit the error artifact and exit cleanly.
+        def _kill_child_locked():
+            # an orphaned isolated child would hold the exclusive TPU
+            # forever (it runs with CXN_BENCH_TIMEOUT=0); caller holds
+            # _EMIT_LOCK, and _SHUTTING_DOWN (set under the same lock)
+            # stops the main thread from spawning a successor
+            global _SHUTTING_DOWN
+            _SHUTTING_DOWN = True
+            p = _CURRENT_CHILD
+            if p is not None:
+                try:
+                    p.kill()
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
         with _EMIT_LOCK:
             if _PARTIAL.get("emitted"):
                 return  # main thread already printed the full result
@@ -1300,10 +1385,12 @@ def main(argv) -> int:
                 _PARTIAL["emitted"] = True
                 _PARTIAL["truncated"] = (
                     f"cut at the {budget}s watchdog")
+                _kill_child_locked()
                 print(json.dumps(
                     {k: v for k, v in _PARTIAL.items()
                      if k != "emitted"}), flush=True)
                 os._exit(0)
+            _kill_child_locked()
         if (os.environ.get("CXN_BENCH_FALLBACK") != "1"
                 and os.environ.get("JAX_PLATFORMS", "") != "cpu"):
             _reexec_cpu(f"backend hung for {budget}s")
@@ -1312,6 +1399,8 @@ def main(argv) -> int:
         os._exit(0)
 
     if budget > 0:
+        global _WATCHDOG_FIRE_AT
+        _WATCHDOG_FIRE_AT = time.monotonic() + budget
         t = threading.Timer(budget, watchdog)
         t.daemon = True
         t.start()

@@ -43,10 +43,16 @@ from cxxnet_tpu.ops.attention import _scale
 
 _NEG = -1e30
 
-# default tile sizes: (128, 128) score tiles feed the MXU exactly;
-# shrunk automatically for short sequences
-BLOCK_Q = 128
-BLOCK_K = 128
+# default tile sizes, set by an on-chip sweep (tools/bench_attn, v5e,
+# b4 h8 s4096 d128 fwd+grads): (1024, 1024) runs 56.7 TFLOP/s
+# non-causal = 4.04x the XLA blockwise path, where the old MXU-exact
+# (128, 128) managed only 0.93x - at 128 the (b, h, s/bq, s/bk) grid
+# is 32k programs whose per-program overhead dominates; 1024-tiles
+# amortize it 64x and Mosaic still sub-tiles the 1024x1024 f32 score
+# block through the MXU. Shrunk automatically for short sequences
+# (_blocks picks the largest divisor of s <= BLOCK).
+BLOCK_Q = 1024
+BLOCK_K = 1024
 
 # Mosaic requires the last two dims of every block shape to be
 # (sublane, lane)-tileable: divisible by (8, 128) or equal to the

@@ -14,8 +14,11 @@ Usage:  python -m cxxnet_tpu.tools.bench_attn [--quick]
 
 Each config is measured fwd+all-grads (the training cost), bf16.
 A config that fails to lower prints an error row instead of aborting
-the sweep. No device->host readbacks (block_until_ready only): a
-single D2H transfer poisons tunneled H2D for the process (docs/perf.md).
+the sweep. Sync is a SCALAR READBACK, not block_until_ready: on some
+tunnel boots block_until_ready is a silent no-op (docs/perf.md) and
+every blocked timing measures dispatch; the one-element readback is
+correct in every observed window, and its sticky H2D poisoning is
+irrelevant here because q/k/v are staged once before the first sync.
 """
 
 from __future__ import annotations
@@ -27,6 +30,22 @@ import time
 import numpy as np
 
 
+def _rsync(tree):
+    """Readback-sync via the harness's shared primitive
+    (bench._readback_sync): block_until_ready is not trustworthy on
+    the tunnel, and a readback is correct in every observed window -
+    its H2D poisoning is irrelevant here because q/k/v are staged once
+    before the first sync (see module docstring)."""
+    try:
+        import bench
+    except ImportError as e:
+        raise RuntimeError(
+            "bench_attn reuses the repo-root bench.py sync primitive; "
+            "run it from a source checkout root (bench.py is not "
+            "packaged)") from e
+    return bench._readback_sync(tree)
+
+
 def measure(core, q, k, v, flops, steps):
     import jax
     f = jax.jit(jax.grad(
@@ -34,12 +53,12 @@ def measure(core, q, k, v, flops, steps):
         argnums=(0, 1, 2)))
     t0 = time.perf_counter()
     g = f(q, k, v)
-    jax.block_until_ready(g)
+    _rsync(g)
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(steps):
         g = f(q, k, v)
-    jax.block_until_ready(g)
+    _rsync(g)
     return steps * flops / (time.perf_counter() - t0) / 1e12, compile_s
 
 
