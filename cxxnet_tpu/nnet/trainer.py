@@ -25,7 +25,7 @@ from __future__ import annotations
 import io as _io
 import os
 import sys
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -44,6 +44,21 @@ from cxxnet_tpu.parallel.mesh import (
 from cxxnet_tpu.parallel.sharding import shardings_for
 from cxxnet_tpu.updater import UpdaterParam, create_updater
 from cxxnet_tpu.utils.metric import MetricSet
+
+
+class StagedBatch(NamedTuple):
+    """A training batch whose device buffers are already staged under
+    the jitted step's in_shardings (stage_batch). update() accepts it
+    and skips ALL per-step host work (pad, cast, H2D) - the TPU-first
+    analog of the reference's membuffer (iter_mem_buffer-inl.hpp: a
+    RAM-resident HOST buffer): a dataset that fits HBM streams zero
+    bytes per step, so e2e throughput equals the compute ceiling even
+    over a slow host link."""
+    data: Any
+    extras: Tuple[Any, ...]
+    labels: Dict[str, Any]
+    mask: Any
+    n_examples: int
 
 
 def _bf16_cast(data: np.ndarray) -> np.ndarray:
@@ -729,21 +744,42 @@ class NetTrainer:
         return (padrows(batch.data), padrows(batch.label), mask,
                 tuple(padrows(e).astype(np.float32) for e in extras))
 
-    def update(self, batch: DataBatch) -> None:
-        """One training mini-batch (CXXNetThreadTrainer::Update)."""
+    def stage_batch(self, batch: DataBatch) -> StagedBatch:
+        """Stage a batch's device buffers ONCE for repeated update()
+        calls (see StagedBatch). The staging runs the exact per-step
+        pipeline (pad, host cast, put under the step's in_shardings),
+        so a staged update is trajectory-identical to a streamed one."""
+        data, label, mask, extras = self._pad_batch(batch, train=True)
+        labels = self._label_fields(label.astype(np.float32))
+        shd = self._batch_sharded
+        return StagedBatch(
+            data=self._put_data(data),
+            extras=tuple(distributed.put_global(e, shd)
+                         for e in extras),
+            labels={k: distributed.put_global(v, shd)
+                    for k, v in labels.items()},
+            mask=distributed.put_global(mask.astype(np.float32), shd),
+            n_examples=batch.batch_size - batch.num_batch_padd)
+
+    def update(self, batch) -> None:
+        """One training mini-batch (CXXNetThreadTrainer::Update).
+        Accepts a DataBatch (streamed: per-step pad/cast/H2D) or a
+        StagedBatch (device-resident: zero per-step host work)."""
         import time as _time
         t0 = _time.perf_counter() if self.profile else 0.0
-        data, label, mask, extras = self._pad_batch(batch, train=True)
+        if not isinstance(batch, StagedBatch):
+            # the streamed path IS one stage_batch call - structural
+            # guarantee of the staged/streamed trajectory equivalence.
+            # Staging also validates; a rejected batch must raise
+            # BEFORE the step counter moves, or a caller that catches
+            # the error would silently shift the whole RNG stream
+            batch = self.stage_batch(batch)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.seed + 100), self._step_counter)
         self._step_counter += 1
-        labels = self._label_fields(label.astype(np.float32))
-        shd = self._batch_sharded
-        gdata = self._put_data(data)
-        gextras = tuple(distributed.put_global(e, shd) for e in extras)
-        glabels = {k: distributed.put_global(v, shd)
-                   for k, v in labels.items()}
-        gmask = distributed.put_global(mask.astype(np.float32), shd)
+        gdata, gextras = batch.data, batch.extras
+        glabels, gmask = batch.labels, batch.mask
+        n_examples = batch.n_examples
         if self.profile:
             # host-side prep (padding, casting, H2D staging) vs device
             # step, reported separately by StepProfiler.summary
@@ -766,8 +802,7 @@ class NetTrainer:
                 # distinct-instance count: wrap/pad rows in
                 # num_batch_padd would inflate images/sec
                 self.profiler.add_step(
-                    _time.perf_counter() - t0,
-                    batch.batch_size - batch.num_batch_padd)
+                    _time.perf_counter() - t0, n_examples)
 
     def update_all(self, data_iter, eval_iters=None,
                    eval_names=None) -> None:
