@@ -194,6 +194,19 @@ def test_child_only_mode_emits_fragment(tmp_path, monkeypatch):
     assert frag["compute_ips"] > 0
 
 
+@pytest.mark.slow
+def test_bench_googlenet_extra_runs(monkeypatch, tmp_path):
+    """The googlenet bench extra (streamed + device-resident variants)
+    builds its own trainer with override keys that must track the
+    config surface - run it for real at a tiny batch (platform gate
+    bypassed, CPU executes; slow: a GoogLeNet compile)."""
+    monkeypatch.setenv("CXN_BENCH_CACHE_DIR", str(tmp_path / "cache"))
+    import bench
+    out = bench._bench_googlenet(2, 1, "tpu")
+    assert out.get("googlenet_ips", 0) > 0, out
+    assert out.get("googlenet_devicedata_ips", 0) > 0, out
+
+
 def test_bench_error_artifact_is_json():
     """A crash before any measurement must still print the one-line
     JSON contract (value 0.0 + error), rc=0."""
