@@ -181,12 +181,10 @@ def test_run_isolated_wraps_failures(monkeypatch):
 
 def test_child_only_mode_emits_fragment(tmp_path, monkeypatch):
     """python bench.py --only NAME prints exactly one JSON fragment."""
-    import subprocess
-    import sys as _sys
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                CXN_BENCH_CACHE_DIR=str(tmp_path / "cache"))
     r = subprocess.run(
-        [_sys.executable, os.path.join(REPO, "bench.py"),
+        [sys.executable, os.path.join(REPO, "bench.py"),
          "--only", "compute", "--steps", "1", "--batch", "4"],
         capture_output=True, text=True, timeout=600, env=env)
     assert r.returncode == 0, r.stderr[-500:]
