@@ -891,8 +891,22 @@ def _m_e2e(ctx) -> dict:
 
 
 def _m_compute(ctx) -> dict:
-    return {"compute_ips": round(
+    out = {"compute_ips": round(
         _measure_compute(ctx.trainer, ctx.batch, ctx.steps), 2)}
+    try:
+        # HBM high-water mark after a full train step - the parity
+        # datum for the reference's ">3 GB GPU memory at batch 256"
+        # claim (example/ImageNet/README.md:7-10). memory_stats is
+        # client metadata, not a buffer transfer; absent on backends
+        # that don't expose it.
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            out["hbm_peak_gb"] = round(peak / 2 ** 30, 2)
+    except Exception:  # noqa: BLE001 - metadata only, never the number
+        pass
+    return out
 
 
 # (name, fn(ctx) -> fragment, gate env var or "", isolated-child
