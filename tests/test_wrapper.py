@@ -79,11 +79,9 @@ def test_train_convenience():
     assert (pred == y[:32]).mean() > 0.85
 
 
-def test_wrapper_dataiter(tmp_path):
-    n, rows, cols = 64, 4, 4
-    rng = np.random.RandomState(0)
-    images = rng.randint(0, 256, size=(n, rows, cols), dtype=np.uint8)
-    labels = rng.randint(0, 2, size=n, dtype=np.uint8)
+def _write_mnist_gz(tmp_path, images, labels):
+    """idx-format .gz fixture shared by the DataIter tests."""
+    n, rows, cols = images.shape
     img_path, lbl_path = str(tmp_path / "i.gz"), str(tmp_path / "l.gz")
     with gzip.open(img_path, "wb") as f:
         f.write(struct.pack(">iiii", 2051, n, rows, cols))
@@ -91,6 +89,15 @@ def test_wrapper_dataiter(tmp_path):
     with gzip.open(lbl_path, "wb") as f:
         f.write(struct.pack(">ii", 2049, n))
         f.write(labels.tobytes())
+    return img_path, lbl_path
+
+
+def test_wrapper_dataiter(tmp_path):
+    n, rows, cols = 64, 4, 4
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, size=(n, rows, cols), dtype=np.uint8)
+    labels = rng.randint(0, 2, size=n, dtype=np.uint8)
+    img_path, lbl_path = _write_mnist_gz(tmp_path, images, labels)
 
     it = DataIter(f"""
 iter = mnist
@@ -141,3 +148,52 @@ silent = 1
     pred = net.predict(x)
     assert pred.shape == (8,)
     assert np.isfinite(pred).all()
+
+
+def test_net_drives_dataiter_batches(tmp_path):
+    """Net.update/predict/extract with a DataIter argument (the
+    reference cxxnet.py accepts an iterator everywhere a numpy array
+    is accepted) passes the iterator's current batch (DataIter.value
+    is a property); previously untested, so drive every
+    DataIter-accepting method."""
+    n = 32
+    rng = np.random.RandomState(7)
+    images = rng.randint(0, 255, size=(n, 4, 4)).astype(np.uint8)
+    labels = rng.randint(0, 2, size=n).astype(np.uint8)
+    img_path, lbl_path = _write_mnist_gz(tmp_path, images, labels)
+
+    def make_iter():
+        return DataIter(f"""
+iter = mnist
+path_img = "{img_path}"
+path_label = "{lbl_path}"
+batch_size = 16
+input_flat = 1
+silent = 1
+""")
+
+    cfg = """
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 16
+eta = 0.1
+silent = 1
+"""
+    net = Net(dev="cpu", cfg=cfg)
+    net.init_model()
+    it = make_iter()
+    while it.next():
+        net.update(it)
+    it.before_first()
+    assert it.next()
+    pred = net.predict(it)
+    assert pred.shape == (16,)
+    dist = net.predict_dist(it)
+    assert dist.shape == (16, 8)  # fc1 nhidden=8 feeds softmax
+    feat = net.extract(it, "top[-2]")  # pre-softmax node
+    assert feat.shape[0] == 16
