@@ -153,6 +153,13 @@ def _snapshot(out: dict) -> None:
         _PARTIAL.update(out)
         if emitted:
             _PARTIAL["emitted"] = True
+    # archive incrementally (outside the lock - file IO must not
+    # stall the watchdog): numbers measured before a mid-run wedge
+    # reach docs/last_good_tpu.json even if run() never returns
+    try:
+        _save_last_good(out)
+    except Exception as e:  # noqa: BLE001 - archiving is best-effort
+        sys.stderr.write(f"bench: last-good archive failed: {e}\n")
 
 
 # How a measurement waits for the device. "block" = jax.block_until_ready
@@ -963,6 +970,7 @@ _MEASUREMENTS = (
 _GFLOP_PER_IMG = {
     "compute_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
+    "e2e_devicedata_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_f32stage_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "device_augment_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_eval_train_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
@@ -1141,6 +1149,107 @@ def _setup_compile_cache(platform: str = "") -> None:
         sys.stderr.write(f"bench: compile cache unavailable: {e}\n")
 
 
+_LAST_GOOD_PATH = os.path.join(_REPO, "docs", "last_good_tpu.json")
+# capability evidence worth carrying across rounds: throughput/TFLOPs
+# fields (per-field best across verified-sync runs) + the labels that
+# make them interpretable
+_LAST_GOOD_MAX_FIELDS = (
+    "compute_ips", "e2e_ips", "e2e_devicedata_ips",
+    "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
+    "device_augment_ips", "chip_matmul_tflops", "attn_pallas_tflops",
+    "attn_pallas_speedup", "achieved_tflops", "mfu_pct")
+_LAST_GOOD_LABEL_FIELDS = ("device_kind", "per_device_batch",
+                           "pool_grad", "sync_mode")
+
+
+def _field_verified(out: dict, field: str) -> bool:
+    """Is this field's number trustworthy enough to archive? Each
+    isolated child annotates its measurement with <name>_sync
+    (readback / readback_unverified); block-mode timings carry no
+    annotation and are trusted (block_until_ready passed the physics
+    calibration). Inline readback mode has no post-measurement
+    verification at all - never archive from it."""
+    ann = out.get(f"{_SYNC_SOURCE.get(field, field)}_sync")
+    if ann is not None:
+        return ann != "readback_unverified"
+    return out.get("sync_mode", "block") == "block"
+
+
+def _save_last_good(out: dict) -> None:
+    """Persist trustworthy chip numbers from a real TPU run so a
+    future wedged-window round's CPU fallback can still publish them
+    (labeled) in its artifact. Per-field best with per-field dates and
+    a per-field sync gate: a link-bound or unverified window must not
+    erase (or launder into) better verified evidence for an unrelated
+    field. Called from _snapshot after every merge, so numbers
+    measured before a mid-run wedge are archived even when the
+    watchdog, not run(), emits the artifact. No headline-value gate:
+    a run whose e2e/compute children all failed can still carry
+    verified extras (chip_matmul, attention) worth archiving."""
+    if out.get("platform") != "tpu" or "fallback" in out:
+        return
+    try:
+        with open(_LAST_GOOD_PATH) as f:
+            rec = json.load(f)
+    except Exception:  # noqa: BLE001 - absent/corrupt: start fresh
+        rec = {}
+    fields = rec.setdefault("fields", {})
+    dates = rec.setdefault("dates", {})
+    today = time.strftime("%Y-%m-%d")
+    dirty = False
+    for k in _LAST_GOOD_MAX_FIELDS:
+        v = out.get(k)
+        if v and _field_verified(out, k) and v > fields.get(k, 0.0):
+            fields[k], dates[k] = v, today
+            dirty = True
+    if not dirty and os.path.exists(_LAST_GOOD_PATH):
+        return  # nothing new: skip the rewrite (runs every snapshot)
+    for k in _LAST_GOOD_LABEL_FIELDS:
+        if k in out:
+            rec[k] = out[k]
+    rec["provenance"] = (
+        "per-field best across verified-sync bench.py TPU runs of this "
+        "checkout; cross-field ratios are cross-window estimates")
+    rec["updated"] = today
+    try:
+        tmp = _LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+        os.replace(tmp, _LAST_GOOD_PATH)
+    except OSError as e:
+        sys.stderr.write(f"bench: could not save last-good: {e}\n")
+
+
+# measurement-child sync annotations live under the MEASUREMENT name,
+# not the field name; map archived fields back to their measurement
+_SYNC_SOURCE = {
+    "compute_ips": "compute", "e2e_ips": "e2e",
+    "e2e_devicedata_ips": "device_data",
+    "compute_poolties_ips": "pool_ties", "googlenet_ips": "googlenet",
+    "googlenet_devicedata_ips": "googlenet",
+    "device_augment_ips": "device_augment",
+    "chip_matmul_tflops": "chip_matmul",
+    "attn_pallas_tflops": "attention", "attn_pallas_speedup": "attention",
+    # derived from e2e_ips, so they share its verification
+    "achieved_tflops": "e2e", "mfu_pct": "e2e",
+}
+
+
+def _merge_last_good(out: dict) -> None:
+    """On a non-TPU (fallback) run, surface the committed last-good
+    chip numbers under a clearly-labeled nested object so a wedged
+    driver window never again publishes ONLY a CPU number (round-4
+    post-mortem: BENCH_r04.json was 3.17 img/s CPU noise while the
+    real chip evidence sat in a side file)."""
+    try:
+        with open(_LAST_GOOD_PATH) as f:
+            rec = json.load(f)
+    except Exception:  # noqa: BLE001 - no archive, nothing to merge
+        return
+    if rec.get("fields"):
+        out["last_measured_tpu"] = rec
+
+
 def _reexec_cpu(reason: str) -> None:
     """Re-exec this process onto the CPU backend (the only escape from
     a PJRT client init hanging in C with signals undeliverable). On
@@ -1248,6 +1357,10 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
     if os.environ.get("CXN_BENCH_FALLBACK") == "1":
         src = os.environ.get("CXN_BENCH_FALLBACK_FROM", "default")
         out["fallback"] = f"backend '{src}' hung; CPU harness run"
+    if platform != "tpu":
+        # merged before the first snapshot so even a watchdog-truncated
+        # fallback artifact carries the archived chip evidence
+        _merge_last_good(out)
 
     # which sync primitive can be trusted THIS boot (see _SYNC_MODE)
     out.update(_calibrate_sync(platform, peak_tflops))
@@ -1401,9 +1514,23 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
             _physics_check(out, peak_tflops, ndev)
             _derive(out, batch, platform, ndev, peak_tflops)
             _snapshot(out)
-    if "value" not in out:
-        out.update(value=0.0, vs_baseline=0.0)
+    _finalize(out, platform)
     return out
+
+
+def _finalize(out: dict, platform: str) -> None:
+    """run()'s tail: label an all-failed artifact, archive a good one."""
+    if "value" not in out:
+        # every measurement failed: the metric name still says "e2e",
+        # so the zero must be self-describing (value_is=none), not
+        # readable as an e2e result of 0
+        out.update(value=0.0, vs_baseline=0.0, value_is="none")
+        # an all-failed run ON the TPU platform (tunnel wedged mid-run)
+        # is exactly the wedged-window class the archive exists for -
+        # the zeroed artifact must still carry the chip evidence
+        _merge_last_good(out)
+    elif platform == "tpu":
+        _save_last_good(out)
 
 
 def _error_json(msg: str) -> str:
@@ -1432,9 +1559,22 @@ def main(argv) -> int:
 
     if only:
         # isolated-measurement child: one fragment on stdout, rc=0 on
-        # success; errors go to rc=1 + stderr (the parent wraps them
-        # into a *_error field). No watchdog - the parent enforces the
-        # timeout and can SIGKILL a child wedged inside PJRT.
+        # success; errors go to rc=1 + stderr. When bench.py is the
+        # spawner it sets CXN_BENCH_TIMEOUT=0 and enforces the timeout
+        # itself (it can SIGKILL a child wedged inside PJRT); a child
+        # run BY HAND still sees the default budget, so honor it with
+        # a local watchdog - a wedged tunnel must never hang a
+        # hand-run child forever
+        if budget > 0:
+            def _only_watchdog():
+                sys.stderr.write(
+                    f"bench --only {only}: exceeded {budget}s "
+                    "(hung backend / stuck tunnel?)\n")
+                sys.stderr.flush()
+                os._exit(1)
+            wt = threading.Timer(budget, _only_watchdog)
+            wt.daemon = True
+            wt.start()
         try:
             print(json.dumps(_child_run(only, batch, steps,
                                         profile_dir)), flush=True)

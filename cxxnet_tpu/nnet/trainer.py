@@ -805,11 +805,21 @@ class NetTrainer:
                     _time.perf_counter() - t0, n_examples)
 
     def update_all(self, data_iter, eval_iters=None,
-                   eval_names=None) -> None:
-        """Convenience: one full pass (round) over a data iterator."""
+                   eval_names=None) -> str:
+        """Convenience: one full pass (round) over a data iterator,
+        then evaluate each of eval_iters (named by eval_names,
+        default eval/eval2/...) - the reference's per-round loop body
+        (cxxnet_main.cpp:367-405). Returns the concatenated
+        reference-format metric string ('' when no eval iters)."""
         data_iter.before_first()
         while data_iter.next():
             self.update(data_iter.value())
+        parts = []
+        for i, it in enumerate(eval_iters or ()):
+            name = (eval_names[i] if eval_names and i < len(eval_names)
+                    else ("eval" if i == 0 else f"eval{i + 1}"))
+            parts.append(self.evaluate(it, name))
+        return "".join(parts)
 
     # ------------------------------------------------------------------
     # evaluation / inference api

@@ -16,9 +16,21 @@ EVERY source position equal to the window max - on ties (ubiquitous
 after relu, where windows are full of equal zeros) ALL tied positions
 receive the full gradient. XLA's native reduce_window-max gradient
 (select_and_scatter) picks a single winner instead, so max_pool2d
-carries a custom_vjp implementing the reference rule exactly, built
-from ky*kx shifted comparisons (fuses to elementwise work; also avoids
-select_and_scatter, a historically slow lowering on TPU).
+carries a custom_vjp implementing the reference rule exactly.
+
+The tie rule separates exactly into two 1-D unpools: with
+r = rowmax(x) and m = colmax(r), x <= r <= m gives
+[x==r]*[r==m] == [x==m], so distributing g through the column max
+(onto r) and then through the row max (onto x) duplicates gradient to
+exactly the positions the 2-D rule would. Each 1-D unpool only
+enumerates the ceil(k/stride) windows that can cover a position
+(window o covers p iff o = p//s - d with p%s + d*s < k), so the
+backward costs ~2*ceil(k/s) half-size elementwise passes instead of
+the ky*kx full-tensor passes of the naive formulation - for the
+AlexNet/GoogLeNet 3x3 stride-2 pools that is 4 small passes vs 9 big
+ones, and it is what makes `pool_grad=ties` (exact mshadow parity)
+affordable on TPU. Still no select_and_scatter anywhere (historically
+a slow lowering on TPU).
 """
 
 from __future__ import annotations
@@ -56,12 +68,13 @@ def pool2d(x: jax.Array, mode: str, ksize_y: int, ksize_x: int,
 
     grad_mode (max pooling only): 'ties' (default) is the reference's
     unpool rule - every source equal to the window max receives the
-    full gradient (see module docstring). 'winner' opts into XLA's
-    native reduce_window-max gradient (select_and_scatter: one winner
-    per window, the cuDNN-style rule) - a DOCUMENTED semantics change
-    on tied windows, exposed as `pool_grad = winner` for workloads
-    where the bwd's ky*kx shifted-compare traffic shows up in the
-    profile and exact mshadow tie parity is not required.
+    full gradient, via the separable ~2*ceil(k/s)-pass backward (see
+    module docstring). 'winner' opts into XLA's native
+    reduce_window-max gradient (select_and_scatter: one winner per
+    window, the cuDNN-style rule) - a DOCUMENTED semantics change on
+    tied windows, exposed as `pool_grad = winner` for workloads where
+    even the separable tie backward shows up in the profile and exact
+    mshadow tie parity is not required.
     """
     if grad_mode not in ("ties", "winner"):
         raise ValueError(f"unknown grad_mode {grad_mode!r}")
@@ -110,37 +123,65 @@ def max_pool2d(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x):
 
 
 def _max_pool_fwd(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x):
-    out = max_pool2d(x, ky, kx, stride, pad_y, pad_x, hi_y, hi_x)
-    return out, (x, out)
+    # separable forward: identical values to the 2-D reduce_window
+    # (max is associative), but the row-max intermediate r is exactly
+    # the residual the separable ties backward needs (module docstring)
+    r = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, 1, kx), (1, 1, 1, stride),
+        ((0, 0), (0, 0), (0, 0), (pad_x, hi_x)))
+    out = lax.reduce_window(
+        r, -jnp.inf, lax.max, (1, 1, ky, 1), (1, 1, stride, 1),
+        ((0, 0), (0, 0), (pad_y, hi_y), (0, 0)))
+    return out, (x, r, out)
 
 
-def _upsample_shift(a, stride, dy, dx, hp, wp, fill):
-    """Place a[oy, ox] at padded-input position (oy*stride + dy,
-    ox*stride + dx); everything else = fill. Interior padding by
-    (stride-1) does the strided upsample, edge padding the shift."""
-    cfg = [(0, 0, 0), (0, 0, 0),
-           (dy, hp - dy - (a.shape[2] - 1) * stride - 1, stride - 1),
-           (dx, wp - dx - (a.shape[3] - 1) * stride - 1, stride - 1)]
-    return lax.pad(a, jnp.asarray(fill, a.dtype), cfg)
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _cover_lookup(a, s, d, length, axis, fill):
+    """Array whose index p along `axis` holds a[p//s - d] (`fill` where
+    that index is outside a). With q = p - d*s, q//s == p//s - d
+    exactly, so the strided window lookup is a repeat(s) shifted right
+    by d*s - pure layout ops (broadcast-reshape + pad), no gather."""
+    r = jnp.repeat(a, s, axis=axis) if s > 1 else a
+    cfg = [(0, 0, 0)] * a.ndim
+    cfg[axis] = (d * s, length - r.shape[axis] - d * s, 0)
+    return lax.pad(r, jnp.asarray(fill, a.dtype), cfg)
+
+
+def _unpool_1d(vals, pooled, g, k, s, axis):
+    """One-axis mshadow ties unpool: gin[p] = sum over windows o
+    covering p of g[o] * (vals[p] == pooled[o]), where `vals` is
+    already neutrally padded along `axis`. Only o = p//s - d with
+    d in [0, ceil(k/s)) can cover p, and does iff p%s + d*s < k (a
+    static per-position mask) - so ceil(k/s) passes, not k."""
+    length = vals.shape[axis]
+    shape = [1] * vals.ndim
+    shape[axis] = length
+    phase = (jnp.arange(length) % s).reshape(shape)
+    gin = jnp.zeros(vals.shape, g.dtype)
+    for d in range(_ceil_div(k, s)):
+        m = _cover_lookup(pooled, s, d, length, axis, -jnp.inf)
+        gd = _cover_lookup(g, s, d, length, axis, 0.0)
+        covers = phase + d * s < k
+        gin = gin + jnp.where(covers & (vals == m), gd, 0.0)
+    return gin
 
 
 def _max_pool_bwd(ky, kx, stride, pad_y, pad_x, hi_y, hi_x, res, g):
-    x, out = res
-    hp = x.shape[2] + pad_y + hi_y
-    wp = x.shape[3] + pad_x + hi_x
-    xpad = jnp.pad(x, ((0, 0), (0, 0), (pad_y, hi_y), (pad_x, hi_x)),
-                   constant_values=-jnp.inf)
-    gin = jnp.zeros_like(xpad)
-    for dy in range(ky):
-        for dx in range(kx):
-            # window oy covers padded position i at offset dy iff
-            # i == oy*stride + dy; compare xpad against that window's
-            # max and claim its gradient on equality (ties included)
-            up_out = _upsample_shift(out, stride, dy, dx, hp, wp,
-                                     -jnp.inf)
-            up_g = _upsample_shift(g, stride, dy, dx, hp, wp, 0.0)
-            gin = gin + jnp.where(xpad == up_out, up_g, 0.0)
-    gin = gin[:, :, pad_y:pad_y + x.shape[2], pad_x:pad_x + x.shape[3]]
+    x, r, out = res
+    # step 1: distribute g through the column max, out -> r (rows are
+    # the pooled axis; r spans padded rows only inside the unpool)
+    rp = jnp.pad(r, ((0, 0), (0, 0), (pad_y, hi_y), (0, 0)),
+                 constant_values=-jnp.inf)
+    gr = _unpool_1d(rp, out, g, ky, stride, axis=2)
+    gr = lax.slice_in_dim(gr, pad_y, pad_y + x.shape[2], axis=2)
+    # step 2: distribute gr through the row max, r -> x
+    xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad_x, hi_x)),
+                 constant_values=-jnp.inf)
+    gin = _unpool_1d(xp, r, gr, kx, stride, axis=3)
+    gin = lax.slice_in_dim(gin, pad_x, pad_x + x.shape[3], axis=3)
     return (gin.astype(x.dtype),)
 
 

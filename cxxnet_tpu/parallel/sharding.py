@@ -63,6 +63,18 @@ def param_pspecs(net: Network, shapes=None) -> Dict[str, Dict[str, P]]:
     return specs
 
 
+def zero1_eligible_dim(spec, shape, dsize):
+    """Index of the first still-unsharded dim divisible by the
+    data-axis size - the dim zero1_shardings additionally shards over
+    'data' - or None when the weight keeps its parameter sharding.
+    THE eligibility rule; the multichip dryrun asserts against it."""
+    full = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (ax, dim) in enumerate(zip(full, shape)):
+        if ax is None and dim % dsize == 0:
+            return i
+    return None
+
+
 def zero1_shardings(
         mesh: Mesh, net: Network,
         pshard: Dict[str, Dict[str, NamedSharding]]
@@ -89,14 +101,12 @@ def zero1_shardings(
             if dsize <= 1:
                 out[lk][pn] = ns
                 continue
-            spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
-            for i, ax in enumerate(spec):
-                if ax is None and shape[i] % dsize == 0:
-                    spec[i] = DATA_AXIS
-                    break
-            else:
+            i = zero1_eligible_dim(ns.spec, shape, dsize)
+            if i is None:
                 out[lk][pn] = ns
                 continue
+            spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
+            spec[i] = DATA_AXIS
             out[lk][pn] = NamedSharding(mesh, P(*spec))
     return out
 

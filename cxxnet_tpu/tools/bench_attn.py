@@ -17,8 +17,11 @@ A config that fails to lower prints an error row instead of aborting
 the sweep. Sync is a SCALAR READBACK, not block_until_ready: on some
 tunnel boots block_until_ready is a silent no-op (docs/perf.md) and
 every blocked timing measures dispatch; the one-element readback is
-correct in every observed window, and its sticky H2D poisoning is
-irrelevant here because q/k/v are staged once before the first sync.
+correct in every observed window. Its sticky H2D poisoning cannot
+touch the sweep because the ONLY H2D in this process is the single
+q/k/v staging in main(), shared by every config and performed before
+the first measurement (and hence before the first readback); later
+configs re-jit but never re-stage.
 """
 
 from __future__ import annotations
@@ -34,8 +37,8 @@ def _rsync(tree):
     """Readback-sync via the harness's shared primitive
     (bench._readback_sync): block_until_ready is not trustworthy on
     the tunnel, and a readback is correct in every observed window -
-    its H2D poisoning is irrelevant here because q/k/v are staged once
-    before the first sync (see module docstring)."""
+    and no H2D (timed or untimed) happens after the first one, so its
+    sticky poisoning has nothing to slow (see module docstring)."""
     try:
         import bench
     except ImportError as e:

@@ -91,6 +91,43 @@ def test_padded_pooling_matches_numpy_rule():
                                    err_msg=f"k={k} s={s} p={p}")
 
 
+def test_rect_kernel_and_sparse_stride_match_numpy_rule():
+    """Exercise the separable backward's phase enumeration: rectangular
+    kernels (ky != kx) and stride > kernel (gaps: some positions
+    covered by NO window)."""
+    rng = np.random.RandomState(7)
+    x = rng.randint(0, 3, (2, 2, 10, 8)).astype(np.float32)
+
+    def rect_grad(ky, kx, s):
+        oh = pool_out_dim(x.shape[2], ky, s)
+        ow = pool_out_dim(x.shape[3], kx, s)
+        g = rng.randn(x.shape[0], x.shape[1], oh, ow).astype(np.float32)
+        gr = jax.grad(lambda v: jnp.sum(
+            pool2d(v, "max", ky, kx, s) * g))(jnp.asarray(x))
+        return np.asarray(gr), g
+
+    def numpy_rect(g, ky, kx, s):
+        b, c, h, w = x.shape
+        gp = np.zeros_like(x)
+        for oy in range(g.shape[2]):
+            for ox in range(g.shape[3]):
+                win = x[:, :, oy * s:oy * s + ky, ox * s:ox * s + kx]
+                m = win.max(axis=(2, 3), keepdims=True)
+                gp[:, :, oy * s:oy * s + ky, ox * s:ox * s + kx] += \
+                    np.where(win == m, g[:, :, oy:oy + 1, ox:ox + 1], 0.0)
+        return gp
+
+    for ky, kx, s in ((3, 2, 2), (2, 3, 1), (2, 2, 3), (1, 3, 2)):
+        gr, g = rect_grad(ky, kx, s)
+        np.testing.assert_allclose(gr, numpy_rect(g, ky, kx, s),
+                                   rtol=1e-6, atol=1e-6,
+                                   err_msg=f"ky={ky} kx={kx} s={s}")
+        if s > kx:
+            # stride gaps: columns with p % s >= kx are covered by no
+            # window and must get exactly zero gradient
+            assert np.all(gr[:, :, :, kx::s] == 0), (ky, kx, s)
+
+
 def test_truncated_boundary_window():
     # reference ceil formula: in=5, k=2, s=2 -> out=3, last window
     # truncated to a single column/row

@@ -87,6 +87,21 @@ def test_training_converges():
     assert out.startswith("\ttest-error:")
 
 
+def test_update_all_runs_evals():
+    """update_all's eval_iters/eval_names must actually evaluate (they
+    were silently ignored until round 5) and return the reference-
+    format metric string; without eval iters it returns ''."""
+    t = make_trainer()
+    batches = synth_batches(4)
+    assert t.update_all(ListIter(batches)) == ""
+    out = t.update_all(ListIter(batches),
+                       eval_iters=[ListIter(synth_batches(2, seed=1)),
+                                   ListIter(synth_batches(2, seed=2))],
+                       eval_names=["test"])
+    assert "\ttest-error:" in out
+    assert "\teval2-error:" in out  # default name for unnamed iters
+
+
 def test_epoch_counter_and_update_period():
     t = make_trainer(extra="update_period = 2\n")
     batches = synth_batches(4)
