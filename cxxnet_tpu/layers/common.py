@@ -201,9 +201,27 @@ class ConvolutionLayer(Layer):
     reference's (ngroup, out/g, in/g*ky*kx) 3-D layout is the same memory
     order, used only at checkpoint conversion. Grouped conv maps to
     feature_group_count (no im2col on TPU).
+
+    `space_to_depth = auto|0|1` (default auto): rewrite a strided
+    few-channel conv (the input layer) as a stride-1 conv over
+    in_ch*s*s channels - value-identical, MXU-dense in both forward
+    and wgrad (ops/conv.py module docstring).
     """
 
     type_name = "conv"
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self.s2d = None  # None = auto heuristic in ops.conv2d
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "space_to_depth":
+            if val not in ("auto", "0", "1"):
+                raise ValueError(
+                    f"space_to_depth must be auto, 0 or 1, got {val!r}")
+            self.s2d = None if val == "auto" else val == "1"
+            return
+        super().set_param(name, val)
 
     def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
         self.check_one_to_one(in_shapes)
@@ -252,7 +270,7 @@ class ConvolutionLayer(Layer):
     def apply(self, params, inputs, *, train, rng=None):
         p = self.param
         out = ops.conv2d(inputs[0], params["wmat"], p.stride, p.pad_y,
-                         p.pad_x, p.num_group)
+                         p.pad_x, p.num_group, s2d=self.s2d)
         if "bias" in params:
             out = out + params["bias"][None, :, None, None]
         return [out]

@@ -8,11 +8,31 @@ MXU, grouped conv maps to feature_group_count, and no scratch bound exists.
 Output-size parity (convolution_layer-inl.hpp:174-177):
     out = (in + 2*pad - k) // stride + 1
 which is exactly lax's explicit-padding convolution arithmetic.
+
+Space-to-depth: an input-layer conv (3 channels, large kernel, stride
+s > 1 - AlexNet's 11x11/s4) is MXU-hostile in both its forward (the
+contraction dim is in_ch*ky*kx but spatially strided) and especially
+its weight gradient (an rhs-dilated conv contracting over batch and
+output positions with only 3 channels). Rewriting it as a stride-1
+conv over in_ch*s*s channels (the MLPerf-era TPU trick) makes both
+directions dense MXU contractions. With dy = q*s + r:
+
+    out[o, y, x] = sum_{i, dy, dx} xpad[i, y*s+dy, x*s+dx] * w[o, i, dy, dx]
+                 = sum_{(i,r,rx), q, qx} X[(i,r,rx), y+q, x+qx] * W'[(i,r,rx), q, qx]
+
+where X is xpad with each s*s spatial block moved into channels and W'
+is w zero-padded to ceil(k/s)*s then block-moved the same way - an
+EXACT reshuffle of the same multiply-adds (same products, same
+channel-major summation groups), not an approximation. The transform
+is applied inside conv2d (weights keep their reference OIHW layout /
+checkpoint format); autodiff then derives the dense-shape wgrad
+automatically.
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 
@@ -21,8 +41,14 @@ def conv_out_dim(in_dim: int, ksize: int, stride: int, pad: int) -> int:
     return (in_dim + 2 * pad - ksize) // stride + 1
 
 
+# auto heuristic bound: s2d pays when the contraction channel count is
+# tiny (the input layer); 3 RGB planes always qualify, a mid-net conv
+# never does
+_S2D_MAX_IN_CH = 4
+
+
 def conv2d(x: jax.Array, w: jax.Array, stride: int, pad_y: int, pad_x: int,
-           num_group: int = 1, precision=None) -> jax.Array:
+           num_group: int = 1, precision=None, s2d=None) -> jax.Array:
     """Grouped 2-D convolution.
 
     x: (batch, in_ch, h, w); w: (out_ch, in_ch // num_group, ky, kx).
@@ -31,9 +57,27 @@ def conv2d(x: jax.Array, w: jax.Array, stride: int, pad_y: int, pad_x: int,
     matches the reference's f32 GEMM (TPU's default would silently run
     bf16 MXU passes); bf16 training (dtype=bfloat16) keeps the fast
     path - that trade is the user's explicit choice there.
+
+    s2d: None = auto (space-to-depth when ungrouped, strided, and
+    in_ch <= 4 - see module docstring); True/False force it. The
+    rewrite computes identical sums regrouped, so values match the
+    direct lowering to float rounding.
     """
     if precision is None and x.dtype == jax.numpy.float32:
         precision = lax.Precision.HIGHEST
+    if s2d is None:
+        s2d = (num_group == 1 and stride > 1
+               and min(w.shape[2], w.shape[3]) >= stride
+               and x.shape[1] <= _S2D_MAX_IN_CH)
+    elif s2d and (num_group != 1 or stride <= 1):
+        # an explicit force that cannot apply must not be silently
+        # dropped - the user would benchmark the unrewritten conv
+        # believing s2d is active
+        raise ValueError(
+            "space_to_depth=1 requires an ungrouped conv with "
+            f"stride > 1 (got num_group={num_group}, stride={stride})")
+    if s2d:
+        return _conv2d_s2d(x, w, stride, pad_y, pad_x, precision)
     return lax.conv_general_dilated(
         x, w,
         window_strides=(stride, stride),
@@ -42,3 +86,43 @@ def conv2d(x: jax.Array, w: jax.Array, stride: int, pad_y: int, pad_x: int,
         feature_group_count=num_group,
         precision=precision,
     )
+
+
+def _blocks_to_channels(a: jax.Array, s: int) -> jax.Array:
+    """(n, c, H, W) -> (n, c*s*s, H/s, W/s): each s*s spatial block
+    becomes s*s channels, channel index (c*s + r)*s + rx."""
+    n, c, h, w = a.shape
+    a = a.reshape(n, c, h // s, s, w // s, s)
+    a = a.transpose(0, 1, 3, 5, 2, 4)
+    return a.reshape(n, c * s * s, h // s, w // s)
+
+
+def _conv2d_s2d(x, w, s, pad_y, pad_x, precision):
+    """Space-to-depth rewrite of an ungrouped strided conv (module
+    docstring). Padded-length bookkeeping: the rewrite needs the
+    (zero-)padded input length to be exactly ((out-1) + ceil(k/s)) * s;
+    positions past the reference's own pad are read only by the
+    zero-padded kernel taps (dy >= k), and trimmed positions are read
+    by no kept output window - so padding/trimming to that length
+    changes nothing."""
+    b, c, h, wd = x.shape
+    oc, ic, ky, kx = w.shape
+    oy = conv_out_dim(h, ky, s, pad_y)
+    ox = conv_out_dim(wd, kx, s, pad_x)
+    kpy, kpx = -(-ky // s), -(-kx // s)
+    zero = jnp.zeros((), x.dtype)
+    xp = lax.pad(x, zero, (
+        (0, 0, 0), (0, 0, 0),
+        (pad_y, (oy - 1 + kpy) * s - h - pad_y, 0),
+        (pad_x, (ox - 1 + kpx) * s - wd - pad_x, 0)))
+    X = _blocks_to_channels(xp, s)
+    wp = lax.pad(w, jnp.zeros((), w.dtype), (
+        (0, 0, 0), (0, 0, 0),
+        (0, kpy * s - ky, 0), (0, kpx * s - kx, 0)))
+    # the SAME block->channel shuffle as the input (one definition of
+    # the channel-index contract, so X and W' cannot disagree)
+    wp = _blocks_to_channels(wp, s)
+    return lax.conv_general_dilated(
+        X, wp, window_strides=(1, 1), padding=((0, 0), (0, 0)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=precision)

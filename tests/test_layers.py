@@ -94,6 +94,83 @@ def test_conv_matches_torch():
     np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
 
 
+def test_conv_space_to_depth_matches_direct():
+    """The s2d rewrite must equal the direct strided conv - values AND
+    both gradients - across kernel/stride/pad geometries including the
+    AlexNet conv1 shape (227, 11x11/s4, no pad) and truncated tails."""
+    from cxxnet_tpu.ops.conv import conv2d
+    rng = np.random.RandomState(3)
+    for h, w_, k, s, p in ((227, 227, 11, 4, 0), (16, 16, 3, 2, 1),
+                           (15, 13, 5, 3, 2), (9, 9, 2, 4, 0),
+                           (12, 10, 4, 2, 0)):
+        x = rng.randn(2, 3, h, w_).astype(np.float32)
+        w = rng.randn(8, 3, k, k).astype(np.float32)
+
+        def loss(x, w, s2d):
+            out = conv2d(jnp.asarray(x), jnp.asarray(w), s, p, p,
+                         s2d=s2d)
+            return out, jnp.sum(out * out)
+
+        out_d, _ = loss(x, w, False)
+        out_s, _ = loss(x, w, True)
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_d),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"h={h} k={k} s={s} p={p}")
+        gd = jax.grad(lambda a, b: loss(a, b, False)[1], (0, 1))(
+            jnp.asarray(x), jnp.asarray(w))
+        gs = jax.grad(lambda a, b: loss(a, b, True)[1], (0, 1))(
+            jnp.asarray(x), jnp.asarray(w))
+        for a, b, nm in ((gs[0], gd[0], "dx"), (gs[1], gd[1], "dw")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                err_msg=f"{nm} h={h} k={k} s={s} p={p}")
+
+
+def test_conv_space_to_depth_auto_gating():
+    """auto engages only for ungrouped, strided, few-channel convs;
+    the rewritten conv is stride-1 over in_ch*s*s channels."""
+    from cxxnet_tpu.ops.conv import conv2d
+    x = jnp.zeros((1, 3, 227, 227), jnp.bfloat16)
+    w = jnp.zeros((96, 3, 11, 11), jnp.bfloat16)
+    jaxpr = str(jax.make_jaxpr(
+        lambda x, w: conv2d(x, w, 4, 0, 0))(x, w))
+    # rewritten: a stride-1 conv (over in_ch*s*s = 48 channels), no
+    # strided conv left in the program
+    assert "window_strides=(1, 1)" in jaxpr, jaxpr
+    assert "window_strides=(4, 4)" not in jaxpr, jaxpr
+    # many channels: auto stays off (a strided conv remains)
+    x2 = jnp.zeros((1, 96, 27, 27), jnp.bfloat16)
+    w2 = jnp.zeros((256, 96, 5, 5), jnp.bfloat16)
+    jaxpr2 = str(jax.make_jaxpr(
+        lambda x, w: conv2d(x, w, 2, 2, 2))(x2, w2))
+    assert "window_strides=(2, 2)" in jaxpr2
+    # grouped: never rewritten even when forced via layer auto
+    x3 = jnp.zeros((1, 4, 16, 16), jnp.bfloat16)
+    w3 = jnp.zeros((8, 2, 3, 3), jnp.bfloat16)
+    jaxpr3 = str(jax.make_jaxpr(
+        lambda x, w: conv2d(x, w, 2, 1, 1, num_group=2))(x3, w3))
+    assert "window_strides=(2, 2)" in jaxpr3
+
+
+def test_conv_space_to_depth_param_validation():
+    layer = make("conv", [("kernel_size", "3"), ("nchannel", "4")])
+    layer.set_param("space_to_depth", "1")
+    assert layer.s2d is True
+    layer.set_param("space_to_depth", "auto")
+    assert layer.s2d is None
+    import pytest
+    with pytest.raises(ValueError, match="space_to_depth"):
+        layer.set_param("space_to_depth", "yes")
+    # a force that cannot apply raises instead of silently dropping
+    from cxxnet_tpu.ops.conv import conv2d
+    with pytest.raises(ValueError, match="space_to_depth=1"):
+        conv2d(jnp.zeros((1, 4, 8, 8)), jnp.zeros((8, 2, 3, 3)),
+               2, 1, 1, num_group=2, s2d=True)
+    with pytest.raises(ValueError, match="space_to_depth=1"):
+        conv2d(jnp.zeros((1, 3, 8, 8)), jnp.zeros((8, 3, 3, 3)),
+               1, 1, 1, s2d=True)
+
+
 def test_grouped_conv_matches_torch():
     rng = np.random.RandomState(2)
     x = rng.randn(2, 4, 8, 8).astype(np.float32)
