@@ -779,6 +779,62 @@ def _bench_device_data(ctx) -> dict:
         return {"device_data_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_prefetch(ctx) -> dict:
+    """Streamed e2e THROUGH the H2D staging prefetcher
+    (trainer.prefetch, io/prefetch.py): batch k+1's pad + cast +
+    device_put runs on a worker thread while step k executes - the
+    reference ThreadBuffer idea at the host->device edge
+    (thread_buffer.h:22-202). The delta vs `e2e_ips` prices the
+    double buffering; on a healthy host link (not this tunnel)
+    e2e_prefetch_ips >= 0.9 x compute_ips is the product bar for
+    streamed training. Runs on CPU too (the overlap logic is
+    platform-free). Disable with CXN_BENCH_PREFETCH=0."""
+    if os.environ.get("CXN_BENCH_PREFETCH") == "0":
+        return {}
+    try:
+        from cxxnet_tpu.io.data import DataBatch
+        tr = ctx.trainer
+        batch = ctx.batch
+        rng = np.random.RandomState(11)
+        nbuf = min(8, ctx.steps)
+        batches = [DataBatch(*_alexnet_batch(rng, batch))
+                   for _ in range(nbuf)]
+
+        class _Cycle:
+            """Minimal DataIter serving n host batches."""
+
+            def __init__(self, n):
+                self.n, self.i = n, -1
+
+            def before_first(self):
+                self.i = -1
+
+            def next(self):
+                self.i += 1
+                return self.i < self.n
+
+            def value(self):
+                return batches[self.i % nbuf]
+
+        n = _warm_and_size(tr,
+                           lambda i: tr.update(batches[i % nbuf]),
+                           ctx.steps, 45.0)
+        pf = tr.prefetch(_Cycle(n), depth=1)
+        try:
+            t0 = time.perf_counter()
+            pf.before_first()
+            while pf.next():
+                tr.update(pf.value())
+            _sync(tr.state)
+            dt = time.perf_counter() - t0
+        finally:
+            pf.close()  # an update() error must not leak the worker
+        return {"e2e_prefetch_ips": round(n * batch / dt, 2),
+                "e2e_prefetch_steps": n}
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"e2e_prefetch_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_pool_ties(make, batch, steps, platform: str) -> dict:
     """Compute-path throughput with `pool_grad = ties` (the reference's
     tie-duplicating max-pool backward) vs the bench flagship's
@@ -937,6 +993,7 @@ _MEASUREMENTS = (
      "compute"),
     ("device_data", _bench_device_data, "CXN_BENCH_DEVDATA", 100,
      "compute"),
+    ("e2e_prefetch", _bench_prefetch, "CXN_BENCH_PREFETCH", 150, "h2d"),
     ("top_ops",
      lambda c: _bench_top_ops(c.trainer, c.batch, c.platform),
      "CXN_BENCH_PROFILE", 150, "h2d"),
@@ -971,6 +1028,7 @@ _GFLOP_PER_IMG = {
     "compute_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_devicedata_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
+    "e2e_prefetch_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_f32stage_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "device_augment_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_eval_train_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
@@ -1154,7 +1212,7 @@ _LAST_GOOD_PATH = os.path.join(_REPO, "docs", "last_good_tpu.json")
 # fields (per-field best across verified-sync runs) + the labels that
 # make them interpretable
 _LAST_GOOD_MAX_FIELDS = (
-    "compute_ips", "e2e_ips", "e2e_devicedata_ips",
+    "compute_ips", "e2e_ips", "e2e_devicedata_ips", "e2e_prefetch_ips",
     "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
     "device_augment_ips", "chip_matmul_tflops", "attn_pallas_tflops",
     "attn_pallas_speedup", "achieved_tflops", "mfu_pct")
@@ -1225,6 +1283,7 @@ def _save_last_good(out: dict) -> None:
 _SYNC_SOURCE = {
     "compute_ips": "compute", "e2e_ips": "e2e",
     "e2e_devicedata_ips": "device_data",
+    "e2e_prefetch_ips": "e2e_prefetch",
     "compute_poolties_ips": "pool_ties", "googlenet_ips": "googlenet",
     "googlenet_devicedata_ips": "googlenet",
     "device_augment_ips": "device_augment",

@@ -1,0 +1,123 @@
+"""Double-buffered host->device staging (the ThreadBuffer at the H2D edge).
+
+The reference hides disk/decode latency behind compute with a generic
+two-semaphore double buffer (utils/thread_buffer.h:22-202) and a
+batch-level ThreadBufferIterator (iter_batch_proc-inl.hpp:136-224).
+On TPU the analogous stall is not the disk but the HOST->DEVICE edge:
+the per-step pad + cast + device_put of batch k+1 serializes after the
+(asynchronously dispatched) step k unless it runs on its own thread.
+
+StagedPrefetcher wraps any DataIter and runs the trainer's FULL
+staging pipeline (trainer.stage_batch: pad, host cast, device_put
+under the step's in_shardings) on a worker thread, `depth` batches
+ahead. value() yields StagedBatch objects, which trainer.update()
+consumes with zero per-step host work - so staging of batch k+1
+overlaps both the host dispatch and the device compute of batch k.
+Trajectory-identical to streaming the DataBatches directly (staging is
+the same code either way; RNG folds on the step counter, not on wall
+time).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+_END = object()
+
+
+class StagedPrefetcher:
+    """DataIter-protocol wrapper: before_first()/next()/value(), where
+    value() returns the staged (device-resident) batch. stage_fn is
+    typically trainer.stage_batch; source is any DataIter yielding
+    DataBatches. depth bounds the device batches held ahead (each
+    pins its buffers in HBM until consumed)."""
+
+    def __init__(self, stage_fn, source, depth: int = 1):
+        self.stage_fn = stage_fn
+        self.source = source
+        self.depth = max(1, int(depth))
+        self._q = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._cur = None
+        self._exhausted = False
+
+    # -- DataIter protocol -------------------------------------------------
+    def before_first(self) -> None:
+        self._shutdown()
+        self.source.before_first()
+        self._q = queue.Queue(maxsize=self.depth)
+        self._stop.clear()
+        self._exhausted = False
+        self._thread = threading.Thread(
+            target=self._run, name="staged-prefetch", daemon=True)
+        self._thread.start()
+
+    def next(self) -> bool:
+        if self._q is None:
+            self.before_first()
+        if self._exhausted:
+            # the worker put ONE _END and exited; a blocking get here
+            # would hang forever
+            return False
+        item = self._q.get()
+        if item is _END:
+            self._exhausted = True
+            return False
+        if isinstance(item, BaseException):
+            # the worker exits after putting its exception; a caller
+            # that catches it and calls next() again must get False,
+            # not a hang on a dead producer's queue
+            self._exhausted = True
+            raise item
+        self._cur = item
+        return True
+
+    def value(self):
+        return self._cur
+
+    def close(self) -> None:
+        """Stop the worker and drop queued staged batches. REQUIRED
+        when abandoning a pass mid-stream (consumer error): the worker
+        otherwise spins in _put holding up to depth staged batches -
+        pinned device memory - alive for the life of the process (the
+        running thread's self-reference also defeats GC). Idempotent;
+        before_first() reopens."""
+        self._shutdown()
+
+    # -- worker ------------------------------------------------------------
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to _shutdown (a plain
+        blocking put would deadlock against a consumer that stopped
+        consuming)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set() and self.source.next():
+                if not self._put(self.stage_fn(self.source.value())):
+                    return
+            self._put(_END)
+        except BaseException as e:  # noqa: BLE001 - re-raised in next()
+            self._put(e)
+
+    def _shutdown(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        # drain so a worker blocked on a full queue can observe _stop
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        self._q = None
+        self._thread = None

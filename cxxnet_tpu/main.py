@@ -40,6 +40,9 @@ class LearnTask:
         self.name_model_dir = "models"
         self.num_round = 10
         self.test_io = 0
+        # depth of the H2D staging prefetch for the train loop
+        # (io/prefetch.py); 0 streams batches on the update thread
+        self.prefetch_stage = 1
         self.batch_size = 0
         self.silent = 0
         self.start_counter = 0
@@ -126,6 +129,8 @@ class LearnTask:
             self.device = val
         if name == "test_io":
             self.test_io = int(val)
+        if name == "prefetch_stage":
+            self.prefetch_stage = int(val)
         if name == "batch_size":
             self.batch_size = int(val)
         if name == "eval_train":
@@ -405,15 +410,30 @@ class LearnTask:
                 print(f"update round {self.start_counter - 1}")
             sample_counter = 0
             self.net_trainer.start_round(self.start_counter)
-            self.itr_train.before_first()
-            while self.itr_train.next():
-                if self.test_io == 0:
-                    self.net_trainer.update(self.itr_train.value())
-                sample_counter += 1
-                if sample_counter % self.print_step == 0 and not self.silent:
-                    elapsed = int(time.time() - start)
-                    print(f"round {self.start_counter - 1:8d}:"
-                          f"[{sample_counter:8d}] {elapsed} sec elapsed")
+            itr = self.itr_train
+            prefetched = self.test_io == 0 and self.prefetch_stage > 0
+            if prefetched:
+                # stage batch k+1 (pad+cast+H2D) on a worker thread
+                # while step k runs (io/prefetch.py); test_io keeps the
+                # raw iterator - it measures the pipeline, not staging
+                itr = self.net_trainer.prefetch(itr, self.prefetch_stage)
+            try:
+                itr.before_first()
+                while itr.next():
+                    if self.test_io == 0:
+                        self.net_trainer.update(itr.value())
+                    sample_counter += 1
+                    if (sample_counter % self.print_step == 0
+                            and not self.silent):
+                        elapsed = int(time.time() - start)
+                        print(f"round {self.start_counter - 1:8d}:"
+                              f"[{sample_counter:8d}] {elapsed} sec "
+                              "elapsed")
+            finally:
+                if prefetched:
+                    # an update() error mid-round must not leak the
+                    # worker + its staged device batches
+                    itr.close()
             self.net_trainer.finish_round_profile()
             if self.test_on_server:
                 # CheckWeight_ analog (async_updater-inl.hpp:144-153):

@@ -761,6 +761,15 @@ class NetTrainer:
             mask=distributed.put_global(mask.astype(np.float32), shd),
             n_examples=batch.batch_size - batch.num_batch_padd)
 
+    def prefetch(self, data_iter, depth: int = 1):
+        """Wrap a DataIter so batch k+1 is staged (pad + cast + H2D)
+        on a worker thread while step k runs - the reference's
+        ThreadBuffer idea applied at the host->device edge
+        (io/prefetch.py). update() consumes the staged values with
+        zero per-step host work; trajectory-identical to streaming."""
+        from cxxnet_tpu.io.prefetch import StagedPrefetcher
+        return StagedPrefetcher(self.stage_batch, data_iter, depth)
+
     def update(self, batch) -> None:
         """One training mini-batch (CXXNetThreadTrainer::Update).
         Accepts a DataBatch (streamed: per-step pad/cast/H2D) or a
