@@ -839,11 +839,14 @@ def _bench_pool_ties(make, batch, steps, platform: str) -> dict:
     """Compute-path throughput with `pool_grad = ties` (the reference's
     tie-duplicating max-pool backward) vs the bench flagship's
     `winner` default - the measured cost of exact mshadow tie parity.
-    On-chip: ties 7,403 img/s vs winner 13,580 img/s (1.83x) - the
-    tie rule's ky*kx shifted-compare HBM traffic was the AlexNet
-    step's single largest cost, which is why the flagship bench runs
-    winner and parity is the opt-in (docs/layer.md). One extra
-    compile; TPU only. Disable with CXN_BENCH_POOLTIES=0."""
+    Round-4 on-chip (old ky*kx shifted-compare backward): ties 7,403
+    vs winner 13,580 within one window (1.83x); best-of-round ties
+    8,226 vs winner 16,067 across windows (~1.95x). Round 5
+    replaced that with the separable two-stage unpool
+    (ops/pooling.py: ~2*ceil(k/s) half-size passes, 4 vs 9 for the
+    AlexNet pools), so THIS field is the defaults decision: if ties
+    now meets the baseline, parity becomes the flagship config too.
+    One extra compile; TPU only. Disable with CXN_BENCH_POOLTIES=0."""
     if platform != "tpu" or os.environ.get("CXN_BENCH_POOLTIES") == "0":
         return {}
     try:

@@ -189,20 +189,44 @@ def train(cfg: str, data, label, num_round: int,
                 for i in range(0, n, batch_size)]
         except Exception:  # noqa: BLE001 - staging is an optimization
             staged = None
-    for r in range(num_round):
-        net.start_round(r)
-        if staged is not None:
-            for s in staged:
-                net._net.update(s)
-        else:
-            for i in range(0, n, batch_size):
-                net.update(data[i:i + batch_size],
-                           label[i:i + batch_size])
-        if eval_data is not None:
-            ed, el = eval_data
-            preds = [net.predict(ed[i:i + batch_size])
-                     for i in range(0, ed.shape[0], batch_size)]
-            pred = np.concatenate(preds)
-            err = float((pred != np.asarray(el).reshape(-1)).mean())
-            _sys.stderr.write(f"[{r}]\teval-error:{err:g}\n")
+    pf = None
+    if staged is None:
+        # large datasets stream - through the H2D staging prefetcher
+        # (io/prefetch.py): batch k+1 padded/cast/device_put on a
+        # worker thread while step k runs, same batches in the same
+        # order as the direct slice loop
+        class _Slices:
+            def before_first(self):
+                self.i = -batch_size
+
+            def next(self):
+                self.i += batch_size
+                return self.i < n
+
+            def value(self):
+                i = self.i
+                return _batch_from_numpy(data[i:i + batch_size],
+                                         label[i:i + batch_size])
+
+        pf = net._net.prefetch(_Slices(), depth=1)
+    try:
+        for r in range(num_round):
+            net.start_round(r)
+            if staged is not None:
+                for s in staged:
+                    net._net.update(s)
+            else:
+                pf.before_first()
+                while pf.next():
+                    net._net.update(pf.value())
+            if eval_data is not None:
+                ed, el = eval_data
+                preds = [net.predict(ed[i:i + batch_size])
+                         for i in range(0, ed.shape[0], batch_size)]
+                pred = np.concatenate(preds)
+                err = float((pred != np.asarray(el).reshape(-1)).mean())
+                _sys.stderr.write(f"[{r}]\teval-error:{err:g}\n")
+    finally:
+        if pf is not None:
+            pf.close()  # a mid-round error must not leak the worker
     return net
