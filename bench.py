@@ -989,29 +989,34 @@ def _m_compute(ctx) -> dict:
 # the poison no longer matters and the verdict samples the same
 # window the measurement ran in.
 _MEASUREMENTS = (
+    # headline pair first, then the round's open DECISIONS (pool_ties:
+    # defaults unification; googlenet: second family, never measured on
+    # chip before r5; device_data: the e2e/compute ratio; e2e_prefetch:
+    # the new overlap), then the established extras - a short tunnel
+    # window spends its budget on what the round needs decided
     ("e2e", _m_e2e, "", 200, "h2d"),
     ("compute", _m_compute, "", 100, "compute"),
-    ("attention",
-     lambda c: _bench_attention(c.platform), "CXN_BENCH_ATTN", 100,
-     "compute"),
+    ("pool_ties",
+     lambda c: _bench_pool_ties(c.make, c.batch, c.steps, c.platform),
+     "CXN_BENCH_POOLTIES", 90, "compute"),
+    ("googlenet",
+     lambda c: _bench_googlenet(c.batch, c.steps, c.platform),
+     "CXN_BENCH_GOOGLENET", 100, "h2d"),
     ("device_data", _bench_device_data, "CXN_BENCH_DEVDATA", 100,
      "compute"),
     ("e2e_prefetch", _bench_prefetch, "CXN_BENCH_PREFETCH", 150, "h2d"),
+    ("attention",
+     lambda c: _bench_attention(c.platform), "CXN_BENCH_ATTN", 100,
+     "compute"),
     ("top_ops",
      lambda c: _bench_top_ops(c.trainer, c.batch, c.platform),
      "CXN_BENCH_PROFILE", 150, "h2d"),
     ("device_augment",
      lambda c: _bench_device_augment(c.batch, c.steps, c.platform),
      "CXN_BENCH_DAUG", 150, "h2d"),
-    ("googlenet",
-     lambda c: _bench_googlenet(c.batch, c.steps, c.platform),
-     "CXN_BENCH_GOOGLENET", 100, "h2d"),
     ("stage_f32",
      lambda c: _bench_stage_f32(c.trainer, c.batch, c.steps, c.platform),
      "CXN_BENCH_STAGEF32", 150, "h2d"),
-    ("pool_ties",
-     lambda c: _bench_pool_ties(c.make, c.batch, c.steps, c.platform),
-     "CXN_BENCH_POOLTIES", 90, "compute"),
     ("chip_matmul",
      lambda c: _bench_chip_matmul(c.platform), "CXN_BENCH_MATMUL", 60,
      "compute"),
