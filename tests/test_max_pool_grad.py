@@ -138,6 +138,26 @@ def test_truncated_boundary_window():
     np.testing.assert_allclose(gr, expect, rtol=1e-6)
 
 
+def test_ties_backward_is_separable_not_quadratic():
+    """Structural pin for the separable backward's cost: the 3x3/s2
+    ties gradient must lower to ~2*ceil(k/s) = 4 covering-window
+    passes (each one pad for the pooled lookup + one for the gradient
+    lookup), NOT the k*k = 9 passes of the naive formulation. Counting
+    pad ops in the jaxpr catches an accidental reintroduction of the
+    quadratic form that the on-chip parity number depends on."""
+    x = jnp.zeros((1, 1, 27, 27), jnp.float32)
+    jaxpr = jax.make_jaxpr(jax.grad(
+        lambda v: jnp.sum(pool2d(v, "max", 3, 3, 2))))(x)
+    n_pad = str(jaxpr).count(" pad[")
+    # 4 covering-window passes x 2 lookups = 8, plus the two neutral
+    # paddings of the operands and the jnp.pad in each _unpool_1d
+    # input; the old ky*kx form needed 18 lookup pads alone. Anything
+    # above 14 means quadratic passes are back.
+    assert n_pad <= 14, f"{n_pad} pad ops - quadratic backward?"
+    # and the backward must not use select_and_scatter (slow on TPU)
+    assert "select_and_scatter" not in str(jaxpr)
+
+
 def test_insanity_pool_backward_credits_slot_positions():
     """Reference rule (insanity_pooling_layer-inl.hpp unpool): the
     gradient credits the window SLOT whose displaced read won, not the
