@@ -17,6 +17,9 @@ REPO = __file__.rsplit("/tests/", 1)[0]
     ("examples/ImageNet/GoogLeNet.conf", 1000,
      {"i3a": (256, 28), "i4e": (832, 14), "i5b": (1024, 7),
       "gap": (1024, 1)}),
+    ("examples/ImageNet/ResNet18.conf", 1000,
+     {"s1b2_o": (64, 56), "s2b2_o": (128, 28), "s3b2_o": (256, 14),
+      "s4b2_o": (512, 7), "gap": (512, 1)}),
     ("examples/kaggle_bowl/bowl.conf", 121, {}),
     ("examples/MNIST/MNIST.conf", 10, {}),
     ("examples/MNIST/MNIST_CONV.conf", 10, {}),

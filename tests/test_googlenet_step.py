@@ -1,8 +1,8 @@
-"""GoogLeNet (inception v1) real train-step evidence: the deepest
-example config compiles and executes fwd+bwd+update with finite
-results — beyond the shape-check in test_example_configs.py.
+"""Deep example configs (GoogLeNet inception v1, ResNet-18) as real
+train-step evidence: each compiles and executes fwd+bwd+update with
+finite results — beyond the shape-check in test_example_configs.py.
 
-~60 s on CPU (compile-dominated): marked slow, excluded from the
+~60 s each on CPU (compile-dominated): marked slow, excluded from the
 default run (pyproject addopts); run with `pytest -m slow`.
 """
 
@@ -17,16 +17,20 @@ from cxxnet_tpu.utils.config import parse_config_file
 pytestmark = pytest.mark.slow
 
 
-def test_googlenet_train_step_runs():
+@pytest.mark.parametrize("conf,batch", [
+    ("examples/ImageNet/GoogLeNet.conf", 4),
+    ("examples/ImageNet/ResNet18.conf", 2),
+])
+def test_deep_example_train_step_runs(conf, batch):
     from __graft_entry__ import _make_trainer
     tr = _make_trainer(
-        parse_config_file("examples/ImageNet/GoogLeNet.conf"),
-        [("batch_size", "4"), ("dev", "cpu"), ("silent", "1"),
+        parse_config_file(conf),
+        [("batch_size", str(batch)), ("dev", "cpu"), ("silent", "1"),
          ("eval_train", "1"), ("save_model", "0")])
     rng = np.random.RandomState(0)
     db = DataBatch(
-        data=rng.randn(4, 3, 224, 224).astype(np.float32),
-        label=rng.randint(0, 1000, (4, 1)).astype(np.float32))
+        data=rng.randn(batch, 3, 224, 224).astype(np.float32),
+        label=rng.randint(0, 1000, (batch, 1)).astype(np.float32))
     tr.update(db)
     tr.update(db)
     jax.block_until_ready(tr.state)

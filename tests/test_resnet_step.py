@@ -1,0 +1,106 @@
+"""ResNet-18 example family: the residual composition (conv no_bias +
+batch_norm + relu + `add` with node fan-out by reuse) trains. A tiny
+residual net runs in the default suite; the full 224x224 config's step
+test lives with GoogLeNet's in test_googlenet_step.py (slow)."""
+
+import numpy as np
+
+import jax
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+_TINY_RESNET = """
+netconfig=start
+layer[0->c1] = conv:conv1
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  no_bias = 1
+layer[c1->b1] = batch_norm:bn1
+layer[b1->r1] = relu
+# basic block, identity shortcut (fan-out by node reuse)
+layer[r1->k1] = conv:blk_conv1
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  no_bias = 1
+layer[k1->kb1] = batch_norm:blk_bn1
+layer[kb1->kr1] = relu
+layer[kr1->k2] = conv:blk_conv2
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  no_bias = 1
+layer[k2->kb2] = batch_norm:blk_bn2
+layer[kb2,r1->ba] = add
+layer[ba->bo] = relu
+# downsample block with projection shortcut
+layer[bo->d1] = conv:ds_conv1
+  kernel_size = 3
+  stride = 2
+  pad = 1
+  nchannel = 16
+  no_bias = 1
+layer[d1->db1] = batch_norm:ds_bn1
+layer[db1->dr1] = relu
+layer[dr1->d2] = conv:ds_conv2
+  kernel_size = 3
+  pad = 1
+  nchannel = 16
+  no_bias = 1
+layer[d2->db2] = batch_norm:ds_bn2
+layer[bo->dp] = conv:ds_proj
+  kernel_size = 1
+  stride = 2
+  nchannel = 16
+  no_bias = 1
+layer[dp->dpb] = batch_norm:ds_projbn
+layer[db2,dpb->da] = add
+layer[da->do] = relu
+layer[do->gap] = avg_pooling
+  kernel_size = 4
+layer[gap->fl] = flatten
+layer[fl->fc] = fullc:head
+  nhidden = 3
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 16
+random_type = kaiming
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+
+def test_tiny_residual_net_trains():
+    t = NetTrainer()
+    for k, v in parse_config_string(_TINY_RESNET):
+        t.set_param(k, v)
+    t.set_param("silent", "1")
+    t.set_param("eval_train", "1")
+    t.init_model()
+    rng = np.random.RandomState(0)
+    # 3 linearly-separable-by-mean classes
+    y = rng.randint(0, 3, size=64)
+    x = (rng.randn(64, 3, 8, 8) * 0.3
+         + y[:, None, None, None] * 1.0).astype(np.float32)
+    batches = [DataBatch(data=x[i:i + 16],
+                         label=y[i:i + 16].reshape(-1, 1)
+                         .astype(np.float32))
+               for i in range(0, 64, 16)]
+    errs = []
+    for r in range(6):
+        t.start_round(r)
+        for b in batches:
+            t.update(b)
+        out = t.eval_train_metric()
+        errs.append(float(out.split("train-error:")[1].split("\t")[0]))
+        t.clear_train_metric()
+    assert errs[-1] < 0.2, errs
+    leaves = jax.tree.leaves(t.state["params"])
+    assert all(bool(np.isfinite(np.asarray(p)).all()) for p in leaves)
+
+
