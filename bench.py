@@ -639,26 +639,25 @@ def _bench_device_augment(batch, steps, platform: str) -> dict:
         return {"device_augment_error": f"{type(e).__name__}: {e}"}
 
 
-def _bench_googlenet(batch, steps, platform: str) -> dict:
-    """Second model family (BASELINE config #5): GoogLeNet e2e
-    images/sec at reduced steps - the concat-heavy inception graph
-    stresses fusion patterns AlexNet doesn't. TPU only (a b256
-    inception compile+run on the host CPU would blow the whole
-    watchdog budget). Disable with CXN_BENCH_GOOGLENET=0."""
-    if platform != "tpu" or os.environ.get("CXN_BENCH_GOOGLENET") == "0":
+def _bench_model_family(conf_name, prefix, gate, batch, steps,
+                        platform: str, seed: int) -> dict:
+    """Shared e2e measurement for a non-flagship model family: streamed
+    images/sec at reduced steps + the device-resident (staged-once)
+    variant, fields named <prefix>_ips / <prefix>_devicedata_ips. TPU
+    only (a b256 deep-net compile+run on the host CPU would blow the
+    whole watchdog budget)."""
+    if platform != "tpu" or os.environ.get(gate) == "0":
         return {}
     try:
-        import jax
         from __graft_entry__ import _make_trainer
         from cxxnet_tpu.io.data import DataBatch
         from cxxnet_tpu.utils.config import parse_config_file
-        conf = os.path.join(_REPO, "examples", "ImageNet",
-                            "GoogLeNet.conf")
+        conf = os.path.join(_REPO, "examples", "ImageNet", conf_name)
         tr = _make_trainer(
             parse_config_file(conf),
             [("batch_size", str(batch)), ("dev", "tpu"), ("silent", "1"),
              ("eval_train", "0"), ("save_model", "0")])
-        rng = np.random.RandomState(4)
+        rng = np.random.RandomState(seed)
         db = DataBatch(
             data=rng.randn(batch, 3, 224, 224).astype(np.float32),
             label=rng.randint(0, 1000, (batch, 1)).astype(np.float32))
@@ -669,23 +668,42 @@ def _bench_googlenet(batch, steps, platform: str) -> dict:
             tr.update(db)
         _sync(tr.state)
         dt = time.perf_counter() - t0
-        out = {"googlenet_ips": round(gsteps * batch / dt, 2),
-               "googlenet_steps": gsteps}
+        out = {f"{prefix}_ips": round(gsteps * batch / dt, 2),
+               f"{prefix}_steps": gsteps}
         # device-resident variant (same compiled step, batch staged
-        # once): the second model family's link-immune number, like
+        # once): the family's link-immune number, like
         # e2e_devicedata_ips for AlexNet - budget-bounded so it can
         # never push the child past its registry timeout and cost the
         # streamed number it supplements
         try:
             ips, _n = _time_staged(tr, [tr.stage_batch(db)],
                                    max(4, gsteps), batch, 25.0)
-            out["googlenet_devicedata_ips"] = round(ips, 2)
+            out[f"{prefix}_devicedata_ips"] = round(ips, 2)
         except Exception as e:  # noqa: BLE001 - keep the streamed number
-            out["googlenet_devicedata_error"] = \
+            out[f"{prefix}_devicedata_error"] = \
                 f"{type(e).__name__}: {e}"
         return out
     except Exception as e:  # noqa: BLE001 - never kill the headline
-        return {"googlenet_error": f"{type(e).__name__}: {e}"}
+        return {f"{prefix}_error": f"{type(e).__name__}: {e}"}
+
+
+def _bench_googlenet(batch, steps, platform: str) -> dict:
+    """Second model family (BASELINE config #5): GoogLeNet, the
+    concat-heavy inception graph - stresses fusion patterns AlexNet
+    doesn't. Disable with CXN_BENCH_GOOGLENET=0."""
+    return _bench_model_family("GoogLeNet.conf", "googlenet",
+                               "CXN_BENCH_GOOGLENET", batch, steps,
+                               platform, seed=4)
+
+
+def _bench_resnet(batch, steps, platform: str) -> dict:
+    """Third model family: ResNet-18 (examples/ImageNet/ResNet18.conf)
+    - residual adds + per-shard batch norm, the add/BN composition the
+    other families don't exercise. Late in the registry: only a
+    generous window measures it. Disable with CXN_BENCH_RESNET=0."""
+    return _bench_model_family("ResNet18.conf", "resnet18",
+                               "CXN_BENCH_RESNET", batch, steps,
+                               platform, seed=6)
 
 
 def _bench_chip_matmul(platform: str) -> dict:
@@ -1017,6 +1035,9 @@ _MEASUREMENTS = (
     ("stage_f32",
      lambda c: _bench_stage_f32(c.trainer, c.batch, c.steps, c.platform),
      "CXN_BENCH_STAGEF32", 150, "h2d"),
+    ("resnet18",
+     lambda c: _bench_resnet(c.batch, c.steps, c.platform),
+     "CXN_BENCH_RESNET", 100, "h2d"),
     ("chip_matmul",
      lambda c: _bench_chip_matmul(c.platform), "CXN_BENCH_MATMUL", 60,
      "compute"),
@@ -1046,6 +1067,10 @@ _GFLOP_PER_IMG = {
     # make this cap more permissive, never flag a real number
     "googlenet_ips": 4.5,
     "googlenet_devicedata_ips": 4.5,
+    # ResNet-18 fwd ~1.8 GFLOP/img x3; deliberately the low end (an
+    # under-estimate only loosens the cap, never flags a real number)
+    "resnet18_ips": 5.0,
+    "resnet18_devicedata_ips": 5.0,
 }
 _TFLOPS_FIELDS = ("chip_matmul_tflops", "attn_pallas_tflops",
                   "attn_xla_tflops")
@@ -1222,6 +1247,7 @@ _LAST_GOOD_PATH = os.path.join(_REPO, "docs", "last_good_tpu.json")
 _LAST_GOOD_MAX_FIELDS = (
     "compute_ips", "e2e_ips", "e2e_devicedata_ips", "e2e_prefetch_ips",
     "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
+    "resnet18_ips", "resnet18_devicedata_ips",
     "device_augment_ips", "chip_matmul_tflops", "attn_pallas_tflops",
     "attn_pallas_speedup", "achieved_tflops", "mfu_pct")
 _LAST_GOOD_LABEL_FIELDS = ("device_kind", "per_device_batch",
@@ -1294,6 +1320,7 @@ _SYNC_SOURCE = {
     "e2e_prefetch_ips": "e2e_prefetch",
     "compute_poolties_ips": "pool_ties", "googlenet_ips": "googlenet",
     "googlenet_devicedata_ips": "googlenet",
+    "resnet18_ips": "resnet18", "resnet18_devicedata_ips": "resnet18",
     "device_augment_ips": "device_augment",
     "chip_matmul_tflops": "chip_matmul",
     "attn_pallas_tflops": "attention", "attn_pallas_speedup": "attention",

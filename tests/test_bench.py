@@ -303,6 +303,17 @@ def test_bench_googlenet_extra_runs(monkeypatch, tmp_path):
     assert out.get("googlenet_devicedata_ips", 0) > 0, out
 
 
+@pytest.mark.slow
+def test_bench_resnet_extra_runs(monkeypatch, tmp_path):
+    """Same protocol for the third family (shared _bench_model_family
+    body, distinct conf/field prefix). Slow: full ResNet-18 compile."""
+    monkeypatch.setenv("CXN_BENCH_CACHE_DIR", str(tmp_path / "cache"))
+    import bench
+    out = bench._bench_resnet(2, 1, "tpu")
+    assert out.get("resnet18_ips", 0) > 0, out
+    assert out.get("resnet18_devicedata_ips", 0) > 0, out
+
+
 def test_bench_error_artifact_is_json():
     """A crash before any measurement must still print the one-line
     JSON contract (value 0.0 + error), rc=0."""
