@@ -21,12 +21,14 @@ each snapshotted, each individually guarded so a failure degrades to an
   (the device-side-augmentation go/no-go in docs/perf.md).
 - ``attn_*``: Pallas flash-attention kernel vs the XLA blockwise path
   (fwd+bwd TFLOP/s) - the kernel's on-silicon validation.
-- ``googlenet_ips``: second model family (BASELINE config #5),
-  concat-heavy inception graph.
+- ``googlenet_ips`` / ``resnet18_ips`` (+ ``*_devicedata_ips``):
+  additional model families - GoogLeNet (BASELINE config #5,
+  concat-heavy inception graph) and ResNet-18 (residual adds +
+  per-shard batch norm; last in the registry).
 - ``e2e_eval_train_ips``: eval_train=1 (the reference's default mode)
   with device-side metric accumulators compiled into the step. Needs a
-  second full AlexNet compile -> deliberately the LAST, most
-  expendable extra.
+  second full AlexNet compile -> a deliberately late, expendable
+  extra.
 
 Partial-result discipline: ``_PARTIAL`` is snapshotted after EVERY
 measurement (compute first). If the watchdog fires mid-run, it emits
@@ -1035,9 +1037,6 @@ _MEASUREMENTS = (
     ("stage_f32",
      lambda c: _bench_stage_f32(c.trainer, c.batch, c.steps, c.platform),
      "CXN_BENCH_STAGEF32", 150, "h2d"),
-    ("resnet18",
-     lambda c: _bench_resnet(c.batch, c.steps, c.platform),
-     "CXN_BENCH_RESNET", 100, "h2d"),
     ("chip_matmul",
      lambda c: _bench_chip_matmul(c.platform), "CXN_BENCH_MATMUL", 60,
      "compute"),
@@ -1047,6 +1046,11 @@ _MEASUREMENTS = (
     ("eval_train",
      lambda c: _bench_eval_train(c.make, c.batch, c.steps),
      "CXN_BENCH_EVALTRAIN", 150, "h2d"),
+    # truly last: a nice-to-have third family must never cost an
+    # established field (chip_matmul anchors mfu_pct) its window budget
+    ("resnet18",
+     lambda c: _bench_resnet(c.batch, c.steps, c.platform),
+     "CXN_BENCH_RESNET", 100, "h2d"),
 )
 
 # physics caps: an images/sec (x GFLOP/img) or TFLOP/s field whose

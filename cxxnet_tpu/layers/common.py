@@ -13,6 +13,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from cxxnet_tpu import ops
 from cxxnet_tpu.layers.base import (
@@ -697,14 +698,22 @@ class BatchNormLayer(Layer):
 
     def _normalize(self, x, slope, bias):
         axes, _ = self._axes(x.shape)
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
-        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        # stats in f32 regardless of compute dtype: a per-channel mean
+        # over ~1M bf16 activations accumulated in bf16 (XLA does not
+        # guarantee a wider accumulator) can be off by whole units,
+        # and var inherits the error squared. One downcast at the end
+        # keeps the layer's output dtype; f32 inputs are unchanged
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.mean((xf - mean) ** 2, axis=axes, keepdims=True)
+        xhat = (xf - mean) * lax.rsqrt(var + self.eps)
         if self._is_conv(x.shape):
-            return xhat * slope[None, :, None, None] \
-                + bias[None, :, None, None]
-        return xhat * slope[None, None, None, :] \
-            + bias[None, None, None, :]
+            out = xhat * slope.astype(jnp.float32)[None, :, None, None] \
+                + bias.astype(jnp.float32)[None, :, None, None]
+        else:
+            out = xhat * slope.astype(jnp.float32)[None, None, None, :] \
+                + bias.astype(jnp.float32)[None, None, None, :]
+        return out.astype(x.dtype)
 
     def apply(self, params, inputs, *, train, rng=None):
         x = inputs[0]

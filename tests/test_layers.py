@@ -355,6 +355,32 @@ def test_batch_norm_fc_normalizes_features():
     np.testing.assert_allclose(m.std(axis=0), 1.0, atol=1e-3)
 
 
+def test_batch_norm_bf16_stats_run_in_f32():
+    """Under bf16 compute, BN stats must accumulate in f32 (XLA does
+    not guarantee a wider accumulator for a bf16 reduce; a per-channel
+    mean over ~1M activations accumulated in bf16 drifts by whole
+    units). Structural: the jaxpr converts the input to f32 before the
+    reductions; behavioral: an offset-heavy bf16 input still comes out
+    centered; contract: the output dtype stays bf16."""
+    layer = make("batch_norm", [("eps", "1e-5")])
+    layer.infer_shapes([(64, 8, 16, 16)])
+    params = layer.init_params(jax.random.PRNGKey(0), [(64, 8, 16, 16)])
+    params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(16.0 + rng.randn(64, 8, 16, 16), jnp.bfloat16)
+
+    def fwd(x):
+        return layer.apply(params, [x], train=True)[0]
+
+    jaxpr = str(jax.make_jaxpr(fwd)(x))
+    assert "convert_element_type[new_dtype=float32" in jaxpr, jaxpr
+    out = fwd(x)
+    assert out.dtype == jnp.bfloat16
+    m = np.asarray(out, np.float32)
+    np.testing.assert_allclose(m.mean(axis=(0, 2, 3)), 0.0, atol=0.05)
+    np.testing.assert_allclose(m.std(axis=(0, 2, 3)), 1.0, atol=0.05)
+
+
 def test_lrn_matches_torch():
     rng = np.random.RandomState(10)
     x = rng.randn(2, 8, 4, 4).astype(np.float32)
