@@ -75,6 +75,32 @@ metric = error
 """
 
 
+def test_tiny_residual_net_data_parallel():
+    """The residual composition under a data:2 mesh: per-shard BN
+    stats (shard_map, zero collectives), conv s2d, ties pooling and
+    the gradient AllReduce compose in one program. Per-shard BN makes
+    the dp trajectory legitimately differ from single-device (the
+    reference's per-GPU behavior), so this asserts execution +
+    finiteness + a working eval, not bit equality."""
+    t = NetTrainer()
+    for k, v in parse_config_string(_TINY_RESNET):
+        t.set_param(k, v)
+    t.set_param("silent", "1")
+    t.set_param("mesh", "data:2")
+    t.init_model()
+    rng = np.random.RandomState(1)
+    y = rng.randint(0, 3, size=16)
+    x = (rng.randn(16, 3, 8, 8) * 0.3
+         + y[:, None, None, None]).astype(np.float32)
+    db = DataBatch(data=x, label=y.reshape(-1, 1).astype(np.float32))
+    t.update(db)
+    t.update(db)
+    leaves = jax.tree.leaves(t.state["params"])
+    assert all(bool(np.isfinite(np.asarray(p)).all()) for p in leaves)
+    pred = t.predict(db)
+    assert pred.shape == (16,)
+
+
 def test_tiny_residual_net_trains():
     t = NetTrainer()
     for k, v in parse_config_string(_TINY_RESNET):
