@@ -1300,12 +1300,21 @@ def _save_last_good(out: dict) -> None:
             dirty = True
     if not dirty and os.path.exists(_LAST_GOOD_PATH):
         return  # nothing new: skip the rewrite (runs every snapshot)
-    for k in _LAST_GOOD_LABEL_FIELDS:
-        if k in out:
-            rec[k] = out[k]
-    rec["provenance"] = (
+    # labels describe a RUN, while fields are per-field maxima possibly
+    # from different runs - so labels are archived per-date under
+    # "contexts" (the per-field dates point into it) and the top-level
+    # labels keep their first-written (seed) values instead of being
+    # clobbered by whichever later run happened to improve one field
+    if dirty:
+        ctx = rec.setdefault("contexts", {}).setdefault(today, {})
+        for k in _LAST_GOOD_LABEL_FIELDS:
+            if k in out:
+                ctx[k] = out[k]
+                rec.setdefault(k, out[k])
+    rec.setdefault("provenance", (
         "per-field best across verified-sync bench.py TPU runs of this "
-        "checkout; cross-field ratios are cross-window estimates")
+        "checkout; labels per run under 'contexts' (dates point into "
+        "it); cross-field ratios are cross-window estimates"))
     rec["updated"] = today
     try:
         tmp = _LAST_GOOD_PATH + ".tmp"
@@ -1673,6 +1682,8 @@ def main(argv) -> int:
             wt = threading.Timer(budget, _only_watchdog)
             wt.daemon = True
             wt.start()
+        else:
+            wt = None
         try:
             print(json.dumps(_child_run(only, batch, steps,
                                         profile_dir)), flush=True)
@@ -1680,6 +1691,11 @@ def main(argv) -> int:
         except Exception as e:  # noqa: BLE001 - parent needs the text
             sys.stderr.write(f"{type(e).__name__}: {e}\n")
             return 1
+        finally:
+            # a completed measurement must not be os._exit(1)'d later
+            # by the leaked Timer when main() is called in-process
+            if wt is not None:
+                wt.cancel()
 
     def watchdog():
         # a hung PJRT client creation blocks in C with the GIL state

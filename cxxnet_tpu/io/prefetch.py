@@ -30,8 +30,10 @@ class StagedPrefetcher:
     """DataIter-protocol wrapper: before_first()/next()/value(), where
     value() returns the staged (device-resident) batch. stage_fn is
     typically trainer.stage_batch; source is any DataIter yielding
-    DataBatches. depth bounds the device batches held ahead (each
-    pins its buffers in HBM until consumed)."""
+    DataBatches. Up to depth+1 staged batches are resident at once
+    (depth queued plus the one the worker holds while the queue is
+    full), each pinning its device buffers in HBM until consumed -
+    budget HBM headroom for depth+1, not depth."""
 
     def __init__(self, stage_fn, source, depth: int = 1):
         self.stage_fn = stage_fn
@@ -42,6 +44,7 @@ class StagedPrefetcher:
         self._stop = threading.Event()
         self._cur = None
         self._exhausted = False
+        self._closed = False
 
     # -- DataIter protocol -------------------------------------------------
     def before_first(self) -> None:
@@ -50,11 +53,17 @@ class StagedPrefetcher:
         self._q = queue.Queue(maxsize=self.depth)
         self._stop.clear()
         self._exhausted = False
+        self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="staged-prefetch", daemon=True)
         self._thread.start()
 
     def next(self) -> bool:
+        if self._closed:
+            # close() is terminal for the current pass: a stray next()
+            # from a consumer's cleanup path must not silently rewind
+            # the source and resurrect a worker nothing will close
+            return False
         if self._q is None:
             self.before_first()
         if self._exhausted:
@@ -80,11 +89,13 @@ class StagedPrefetcher:
     def close(self) -> None:
         """Stop the worker and drop queued staged batches. REQUIRED
         when abandoning a pass mid-stream (consumer error): the worker
-        otherwise spins in _put holding up to depth staged batches -
-        pinned device memory - alive for the life of the process (the
-        running thread's self-reference also defeats GC). Idempotent;
-        before_first() reopens."""
+        otherwise spins in _put holding staged batches - pinned device
+        memory - alive for the life of the process (the running
+        thread's self-reference also defeats GC). Terminal for the
+        pass: next() returns False until before_first() reopens.
+        Idempotent."""
         self._shutdown()
+        self._closed = True
 
     # -- worker ------------------------------------------------------------
     def _put(self, item) -> bool:

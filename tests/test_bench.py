@@ -138,6 +138,17 @@ def test_save_last_good_keeps_per_field_best(monkeypatch, tmp_path):
     bench._save_last_good(dict(base))
     rec = json.load(open(path))
     assert rec["fields"]["compute_ips"] == 16000.0
+    assert rec["per_device_batch"] == 256
+
+    # labels must NOT be clobbered by a later run with a different
+    # config that improves one field; they land per-date in contexts
+    bench._save_last_good(dict(base, per_device_batch=128,
+                               googlenet_ips=2000.0))
+    rec = json.load(open(path))
+    assert rec["fields"]["googlenet_ips"] == 2000.0
+    assert rec["per_device_batch"] == 256          # first write wins
+    assert any(c.get("per_device_batch") == 128
+               for c in rec["contexts"].values())  # run context kept
 
     # a worse later window must not erase the better number...
     worse = dict(base, compute_ips=9000.0, e2e_ips=250.0)

@@ -57,6 +57,26 @@ def test_prefetcher_restarts_on_before_first():
     assert pf.next()
 
 
+def test_prefetcher_close_is_terminal():
+    """next() after close() must report exhaustion, not silently
+    rewind the source and resurrect a worker nothing will close."""
+    batches = synth_batches(3)
+    t = make_trainer()
+    pf = t.prefetch(ListIter(batches), depth=1)
+    pf.before_first()
+    assert pf.next()
+    pf.close()
+    assert not pf.next()
+    assert pf._thread is None  # no resurrected worker
+    pf.close()  # idempotent
+    pf.before_first()  # explicit reopen works
+    count = 0
+    while pf.next():
+        count += 1
+    assert count == len(batches)
+    pf.close()
+
+
 def test_prefetcher_propagates_staging_errors():
     class Boom:
         def before_first(self):
