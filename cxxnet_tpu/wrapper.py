@@ -8,7 +8,7 @@ in-process trainer - same call signatures and semantics, numpy in/out.
 
 from __future__ import annotations
 
-from typing import List, Optional, Union
+from typing import Optional, Union
 
 import numpy as np
 
