@@ -10,7 +10,7 @@ from __future__ import annotations
 from typing import List, Tuple
 
 from cxxnet_tpu.io.data import DataBatch, DataInst
-from cxxnet_tpu.io.iterators import DataIter
+from cxxnet_tpu.io.iterators import DataIter, RetryIterator
 
 
 def create_iterator(cfg: List[Tuple[str, str]]) -> DataIter:
@@ -37,7 +37,11 @@ def create_iterator(cfg: List[Tuple[str, str]]) -> DataIter:
                 it = BatchAdaptIterator(AugmentIterator(ImageIterator()))
             elif val == "threadbuffer":
                 assert it is not None, "must specify input of threadbuffer"
-                it = ThreadBufferIterator(it)
+                # the retry must sit UNDER the producer thread: a read
+                # error inside the producer surfaces to the consumer as
+                # RuntimeError (iter_batch.py next()) with the producer
+                # already dead, where no outer retry can help
+                it = ThreadBufferIterator(RetryIterator(it))
             elif val == "membuffer":
                 assert it is not None, "must specify input of membuffer"
                 it = DenseBufferIterator(it)
@@ -51,7 +55,22 @@ def create_iterator(cfg: List[Tuple[str, str]]) -> DataIter:
         elif it is not None:
             it.set_param(name, val)
     assert it is not None, "must specify iterator by iter=itername"
+    # transient-IO-error retry around the whole chain (iterators.py:
+    # RetryIterator; io_retry / io_retry_backoff config keys). A
+    # threadbuffer top already carries the retry inside its producer,
+    # and retrying a dead producer from outside cannot help - skip the
+    # redundant outer wrapper there. Replay the retry keys from the
+    # block so they reach the wrapper (set_param forwards down the
+    # chain) even though it is created after the block params applied.
+    if not isinstance(it, ThreadBufferIterator):
+        it = RetryIterator(it)
+    for name, val in cfg:
+        if name in ("io_retry", "io_retry_backoff"):
+            it.set_param(name, val)
+        elif name == "iter" and val == "end":
+            break
     return it
 
 
-__all__ = ["DataBatch", "DataInst", "DataIter", "create_iterator"]
+__all__ = ["DataBatch", "DataInst", "DataIter", "RetryIterator",
+           "create_iterator"]

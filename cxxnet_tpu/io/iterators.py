@@ -32,6 +32,80 @@ class DataIter(Generic[T]):
             yield self.value()
 
 
+class RetryIterator(DataIter):
+    """Transparent wrapper adding transient-IO-error retry around
+    next()/before_first() (utils/fault.retry): a network-mount hiccup
+    on a shared dataset costs a backoff, not the training run.
+
+    Config keys (forwarded to the wrapped chain as well):
+    - ``io_retry``: attempts per call (default 3; 1 disables retry)
+    - ``io_retry_backoff``: initial backoff seconds (default 0.05)
+
+    Only OSError (and subclasses - includes the injected-fault
+    InjectedIOError) is considered transient; anything else propagates
+    immediately. NOTE a retried next() re-invokes the underlying chain,
+    which may skip the batch the failed call was assembling - the
+    contract is at-most-once delivery per instance, matching the
+    reference's tolerance for dropped tail batches.
+
+    The ``io.next`` / ``io.before_first`` fault points fire INSIDE the
+    retried call, so injected ``ioerror`` faults are absorbed exactly
+    like real transient errors."""
+
+    def __init__(self, inner: "DataIter"):
+        self.inner = inner
+        self.attempts = 3
+        self.backoff = 0.05
+        self._next = None
+        self._bf = None
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "io_retry":
+            self.attempts = max(1, int(val))
+            self._next = self._bf = None
+        elif name == "io_retry_backoff":
+            self.backoff = float(val)
+            self._next = self._bf = None
+        self.inner.set_param(name, val)
+
+    def init(self) -> None:
+        self.inner.init()
+
+    def _build(self) -> None:
+        from cxxnet_tpu.utils.fault import fault_point, retry
+        deco = retry(attempts=self.attempts, backoff=self.backoff,
+                     retry_on=(OSError,))
+
+        def raw_next():
+            fault_point("io.next")
+            return self.inner.next()
+
+        def raw_before_first():
+            fault_point("io.before_first")
+            self.inner.before_first()
+
+        self._next = deco(raw_next)
+        self._bf = deco(raw_before_first)
+
+    def before_first(self) -> None:
+        if self._bf is None:
+            self._build()
+        self._bf()
+
+    def next(self) -> bool:
+        if self._next is None:
+            self._build()
+        return self._next()
+
+    def value(self):
+        return self.inner.value()
+
+    def __getattr__(self, name):
+        # transparent delegation for chain-specific surface (close,
+        # labels, handles) so wrapping is invisible to callers
+        return getattr(self.inner, name)
+
+
 def shard_quota(n: int, num_worker: int, rank: int):
     """Equalized per-worker shard accounting shared by the base
     iterators (reference discipline iter_thread_imbin-inl.hpp:189-220,

@@ -21,7 +21,10 @@ time).
 from __future__ import annotations
 
 import queue
+import sys
 import threading
+
+from cxxnet_tpu.io.thread_util import drain_and_join
 
 _END = object()
 
@@ -45,10 +48,15 @@ class StagedPrefetcher:
         self._cur = None
         self._exhausted = False
         self._closed = False
+        self._pending_error = None
 
     # -- DataIter protocol -------------------------------------------------
     def before_first(self) -> None:
         self._shutdown()
+        # restarting the pass abandons any undelivered worker error
+        # (the rewind re-reads the same data; a persistent fault will
+        # re-raise on this pass)
+        self._pending_error = None
         self.source.before_first()
         self._q = queue.Queue(maxsize=self.depth)
         self._stop.clear()
@@ -70,7 +78,25 @@ class StagedPrefetcher:
             # the worker put ONE _END and exited; a blocking get here
             # would hang forever
             return False
-        item = self._q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.2)
+                break
+            except queue.Empty:
+                if self._thread is not None and self._thread.is_alive():
+                    continue
+                # worker died without delivering a batch, _END, or an
+                # exception (e.g. killed interpreter-side): one last
+                # race-free sweep, then fail instead of hanging forever
+                try:
+                    item = self._q.get_nowait()
+                    break
+                except queue.Empty:
+                    self._exhausted = True
+                    raise RuntimeError(
+                        "staged-prefetch worker died without delivering "
+                        "a batch or an error; the data pipeline is gone "
+                        "(see stderr for the worker's traceback)")
         if item is _END:
             self._exhausted = True
             return False
@@ -93,9 +119,22 @@ class StagedPrefetcher:
         memory - alive for the life of the process (the running
         thread's self-reference also defeats GC). Terminal for the
         pass: next() returns False until before_first() reopens.
-        Idempotent."""
+        Idempotent.
+
+        A worker exception still queued (the consumer stopped before
+        next() could deliver it) is raised here rather than swallowed -
+        unless close() is itself running from an exception handler, in
+        which case the in-flight error wins and the worker's is noted
+        on stderr."""
         self._shutdown()
         self._closed = True
+        err, self._pending_error = self._pending_error, None
+        if err is not None:
+            if sys.exc_info()[1] is None:
+                raise err
+            sys.stderr.write(
+                f"staged-prefetch: worker error superseded by the "
+                f"consumer's: {type(err).__name__}: {err}\n")
 
     # -- worker ------------------------------------------------------------
     def _put(self, item) -> bool:
@@ -122,13 +161,16 @@ class StagedPrefetcher:
     def _shutdown(self) -> None:
         if self._thread is None:
             return
-        self._stop.set()
-        # drain so a worker blocked on a full queue can observe _stop
-        while self._thread.is_alive():
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=0.05)
+        # bounded drain-while-join (thread_util discipline shared with
+        # the rest of io/): a worker stuck outside q.put fails loudly
+        # after the timeout instead of hanging the trainer; drained
+        # worker exceptions are kept, not discarded
+        def keep_error(item):
+            if (isinstance(item, BaseException)
+                    and self._pending_error is None):
+                self._pending_error = item
+
+        drain_and_join(self._q, self._thread, self._stop,
+                       on_item=keep_error)
         self._q = None
         self._thread = None

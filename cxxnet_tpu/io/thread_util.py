@@ -26,9 +26,14 @@ def stoppable_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
 
 
 def drain_and_join(q: "queue.Queue", thread: threading.Thread,
-                   stop: threading.Event, timeout: float = 30.0) -> None:
+                   stop: threading.Event, timeout: float = 30.0,
+                   on_item=None) -> None:
     """Stop a producer: set the flag, drain so a pending put unblocks,
     join with a bounded total wait.
+
+    `on_item` sees every drained queue item - so a shutdown can notice
+    an undelivered worker EXCEPTION instead of silently discarding it
+    (io/prefetch.py surfaces those from close()).
 
     Raises RuntimeError if the producer is still alive after `timeout`
     (stuck outside q.put, e.g. a stalled read): restarting on top of a
@@ -36,14 +41,23 @@ def drain_and_join(q: "queue.Queue", thread: threading.Thread,
     stuck pipeline must fail loudly instead."""
     stop.set()
     deadline = time.monotonic() + timeout
-    while thread.is_alive() and time.monotonic() < deadline:
+
+    def drain():
         try:
             while True:
-                q.get_nowait()
+                item = q.get_nowait()
+                if on_item is not None:
+                    on_item(item)
         except queue.Empty:
             pass
+
+    while thread.is_alive() and time.monotonic() < deadline:
+        drain()
         thread.join(timeout=0.1)
     if thread.is_alive():
         raise RuntimeError(
             f"io producer thread failed to stop within {timeout}s "
             "(stalled read?); cannot safely restart the pipeline")
+    # the producer may have completed a final put between the last
+    # drain and its exit - sweep once more so nothing lingers
+    drain()

@@ -24,7 +24,6 @@ from typing import Dict, List, Optional, Sequence, Tuple, Type
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 Shape = Tuple[int, int, int, int]
 Params = Dict[str, jax.Array]

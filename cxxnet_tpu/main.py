@@ -19,6 +19,7 @@ Behavior parity with CXXNetLearnTask (src/cxxnet_main.cpp:16-478):
 from __future__ import annotations
 
 import os
+import struct
 import sys
 import time
 from typing import List, Optional, Tuple
@@ -26,6 +27,7 @@ from typing import List, Optional, Tuple
 from cxxnet_tpu.io import create_iterator
 from cxxnet_tpu.nnet.trainer import NetTrainer
 from cxxnet_tpu.utils.config import parse_config_file
+from cxxnet_tpu.utils.fault import DivergenceError, atomic_writer
 
 
 class LearnTask:
@@ -49,6 +51,9 @@ class LearnTask:
         self.max_round = 1 << 31
         self.continue_training = 0
         self.save_period = 1
+        # checkpoint rotation: keep the newest k %04d.model files
+        # (0 = keep everything, the reference behavior)
+        self.keep_latest = 0
         self.name_model_in = "NULL"
         self.name_pred = "pred.txt"
         self.print_step = 100
@@ -111,6 +116,8 @@ class LearnTask:
             self.continue_training = int(val)
         if name == "save_model":
             self.save_period = int(val)
+        if name == "keep_latest":
+            self.keep_latest = int(val)
         if name == "start_counter":
             self.start_counter = int(val)
         if name == "model_in":
@@ -302,31 +309,79 @@ class LearnTask:
     def _model_name(self, counter: int) -> str:
         return os.path.join(self.name_model_dir, f"{counter:04d}.model")
 
+    def _model_counters(self) -> List[int]:
+        """Sorted %04d.model counters present in model_dir (the pattern
+        accepts 5+ digits: %04d renders them past round 9999)."""
+        import re
+        try:
+            names = os.listdir(self.name_model_dir)
+        except OSError:
+            return []
+        return sorted(int(m.group(1)) for m in
+                      (re.fullmatch(r"(\d{4,})\.model", n) for n in names)
+                      if m)
+
     def _sync_latest_model(self) -> bool:
-        """Probe model_dir/%04d.model ascending, load the newest."""
-        s = self.start_counter
-        last = None
-        while os.path.exists(self._model_name(s)):
-            last = self._model_name(s)
-            s += 1
-        if last is None:
-            return False
-        self.net_trainer = self._create_net()
-        with open(last, "rb") as fi:
-            self.net_trainer.load_model(fi)
-        self.start_counter = s
-        return True
+        """Load the newest VALID checkpoint at or past start_counter,
+        walking backward past corrupt/truncated files (each skip is
+        logged). A crash mid-save or disk corruption must cost at most
+        the lost rounds, never the whole run - and never silently
+        resume from garbage (the reference loads whatever bytes are
+        there, cxxnet_main.cpp:100-113). The scan is listdir-based, not
+        an ascending existence probe, so keep_latest rotation having
+        deleted the early checkpoints does not hide the survivors."""
+        from cxxnet_tpu.nnet import checkpoint
+        counters = [c for c in self._model_counters()
+                    if c >= self.start_counter]
+        while counters:
+            c = counters.pop()
+            path = self._model_name(c)
+            err = checkpoint.validate_file(path)
+            if err is None:
+                try:
+                    self.net_trainer = self._create_net()
+                    with open(path, "rb") as fi:
+                        self.net_trainer.load_model(fi)
+                except (OSError, ValueError, KeyError,
+                        struct.error) as e:
+                    # validate_file can pass formats it cannot cheaply
+                    # check (legacy binaries, whose loader raises
+                    # struct.error/KeyError on garbage); a failed load
+                    # walks back like any other invalid file
+                    err = str(e)
+                    self.net_trainer = None
+            if err is not None:
+                sys.stderr.write(
+                    f"Init: skipping invalid checkpoint {path}: {err}\n")
+                continue
+            # the next save overwrites the first invalid/missing slot,
+            # re-training the lost rounds
+            self.start_counter = c + 1
+            return True
+        return False
+
+    def _newest_model_counter(self) -> Optional[int]:
+        """Largest %04d.model counter present in model_dir, if any."""
+        hits = self._model_counters()
+        return hits[-1] if hits else None
 
     def _load_model(self) -> None:
         base = os.path.basename(self.name_model_in)
         try:
-            self.start_counter = int(base.split(".")[0])
+            self.start_counter = int(base.split(".")[0]) + 1
         except ValueError:
-            print("WARNING: cannot infer start_counter from model name.")
+            # default to one past the newest existing checkpoint so the
+            # next save can never overwrite one (a stale start_counter
+            # here used to clobber existing %04d.model files)
+            newest = self._newest_model_counter()
+            self.start_counter = (newest + 1 if newest is not None
+                                  else self.start_counter + 1)
+            print(f"WARNING: cannot infer start_counter from model name; "
+                  f"using {self.start_counter} (one past the newest "
+                  f"checkpoint in {self.name_model_dir})")
         self.net_trainer = self._create_net()
         with open(self.name_model_in, "rb") as fi:
             self.net_trainer.load_model(fi)
-        self.start_counter += 1
 
     def _copy_model(self) -> None:
         self.net_trainer = self._create_net()
@@ -344,8 +399,38 @@ class LearnTask:
         if self.save_period == 0 or self.start_counter % self.save_period:
             return
         os.makedirs(self.name_model_dir, exist_ok=True)
-        with open(self._model_name(counter), "wb") as fo:
+        # durable save: tmp + fsync + os.replace, so a kill mid-write
+        # leaves at most a *.tmp - %04d.model is complete or absent
+        with atomic_writer(self._model_name(counter)) as fo:
             self.net_trainer.save_model(fo)
+        self._rotate_models(counter)
+
+    def _rotate_models(self, saved: int) -> None:
+        """keep_latest=k: bound the checkpoint set to the k newest
+        %04d.model files (rescue.model and foreign files untouched).
+        Counters past the one just saved are left alone: a stale
+        higher-counter file (e.g. corrupt debris a resume walked back
+        over) must not push fresh valid checkpoints out of the keep
+        window - it is skipped by resume and overwritten in place when
+        the counter catches up."""
+        if self.keep_latest <= 0:
+            return
+        live = [c for c in self._model_counters() if c <= saved]
+        for c in live[:-self.keep_latest]:
+            try:
+                os.remove(self._model_name(c))
+            except OSError:
+                pass  # concurrent cleanup / permissions: rotation is
+                # best-effort, the save itself already succeeded
+
+    def _save_rescue(self) -> str:
+        """Final rescue checkpoint on divergence abort: the last good
+        (rolled-back) params, in a file resume will not probe."""
+        os.makedirs(self.name_model_dir, exist_ok=True)
+        path = os.path.join(self.name_model_dir, "rescue.model")
+        with atomic_writer(path) as fo:
+            self.net_trainer.save_model(fo)
+        return path
 
     # ------------------------------------------------------------------
     def _create_iterators(self) -> None:
@@ -404,6 +489,24 @@ class LearnTask:
         if self.test_io:
             print("start I/O test")
         cc = self.max_round
+        try:
+            self._train_rounds(cc, start)
+        except DivergenceError:
+            # abort, but not empty-handed: the state is the last good
+            # (rolled-back) params - worth a rescue checkpoint
+            path = self._save_rescue()
+            sys.stderr.write(
+                f"divergence guard: training aborted; rescue checkpoint "
+                f"saved to {path}\n")
+            raise
+        final_profile = self.net_trainer.profile_summary()
+        if final_profile:
+            sys.stderr.write(final_profile + "\n")
+            sys.stderr.flush()
+        if not self.silent:
+            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+
+    def _train_rounds(self, cc: int, start: float) -> None:
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
             if not self.silent:
@@ -454,18 +557,14 @@ class LearnTask:
                 sys.stderr.write("\n")
                 sys.stderr.flush()
             self._save_model()
-        final_profile = self.net_trainer.profile_summary()
-        if final_profile:
-            sys.stderr.write(final_profile + "\n")
-            sys.stderr.flush()
-        if not self.silent:
-            print(f"\nupdating end, {int(time.time() - start)} sec in all")
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a predict iterator to generate predictions"
         print("start predicting...")
-        with open(self.name_pred, "w") as fo:
+        # tmp + os.replace: a crash mid-run cannot leave a truncated
+        # prediction file behind (same protocol as checkpoint saves)
+        with atomic_writer(self.name_pred, "w") as fo:
             self.itr_pred.before_first()
             while self.itr_pred.next():
                 batch = self.itr_pred.value()
@@ -484,7 +583,7 @@ class LearnTask:
         assert self.itr_pred is not None, \
             "must specify a predict iterator to generate predictions"
         print("start predicting...")
-        with open(self.name_pred, "w") as fo:
+        with atomic_writer(self.name_pred, "w") as fo:
             self.itr_pred.before_first()
             while self.itr_pred.next():
                 batch = self.itr_pred.value()
@@ -504,7 +603,7 @@ class LearnTask:
         nrow = 0
         dshape = None
         mode = "w" if self.output_format else "wb"
-        with open(self.name_pred, mode) as fo:
+        with atomic_writer(self.name_pred, mode) as fo:
             self.itr_pred.before_first()
             while self.itr_pred.next():
                 batch = self.itr_pred.value()
@@ -518,12 +617,14 @@ class LearnTask:
                         fo.write(" ".join(f"{v:g}" for v in row) + "\n")
                 else:
                     flat.astype("float32").tofile(fo)
-        if dshape is None:
-            os.remove(self.name_pred)  # no stale empty artifact
-            raise ValueError(
-                "task=extract: the pred iterator yielded no data "
-                "(empty list file or dataset smaller than one batch)")
-        with open(self.name_pred + ".meta", "w") as fm:
+            if dshape is None:
+                # raising inside the atomic_writer discards the tmp, so
+                # no empty artifact appears (and a pre-existing output
+                # from an earlier run is left untouched)
+                raise ValueError(
+                    "task=extract: the pred iterator yielded no data "
+                    "(empty list file or dataset smaller than one batch)")
+        with atomic_writer(self.name_pred + ".meta", "w") as fm:
             fm.write(f"{nrow},{dshape[0]},{dshape[1]},{dshape[2]}\n")
         print(f"finished prediction, write into {self.name_pred}")
 

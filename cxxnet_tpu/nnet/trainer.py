@@ -22,7 +22,6 @@ forward-only executable.
 
 from __future__ import annotations
 
-import io as _io
 import os
 import sys
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
@@ -43,6 +42,8 @@ from cxxnet_tpu.parallel.mesh import (
     MeshSpec, build_mesh, parse_device_spec, parse_mesh_spec)
 from cxxnet_tpu.parallel.sharding import shardings_for
 from cxxnet_tpu.updater import UpdaterParam, create_updater
+from cxxnet_tpu.utils import fault
+from cxxnet_tpu.utils.fault import DivergenceError
 from cxxnet_tpu.utils.metric import MetricSet
 
 
@@ -115,6 +116,16 @@ class NetTrainer:
         self._daug_cfg: Dict[str, str] = {}
         self._augment_fn = None
         self.remat = 0
+        # divergence guard (docs/FAULT_TOLERANCE.md): check_nan=1 adds
+        # a jitted all-finite check over loss+params to the train step;
+        # a non-finite step is dropped (params rolled back in-jit) and
+        # max_bad_rounds CONSECUTIVE bad steps raise DivergenceError
+        self.check_nan = 0
+        self._check_nan_built = False
+        self.max_bad_rounds = 3
+        self.bad_rounds = 0        # total dropped steps (this process)
+        self._bad_consec = 0
+        self._skipped_steps = 0
         self.model_format = "native"
         self.profile = 0
         self.profile_dir = ""
@@ -158,6 +169,10 @@ class NetTrainer:
             self.shard_optimizer = 1
         if name == "remat":
             self.remat = int(val)
+        if name == "check_nan":
+            self.check_nan = int(val)
+        if name == "max_bad_rounds":
+            self.max_bad_rounds = int(val)
         if name == "stage_dtype":
             if val not in ("", "float32", "bfloat16"):
                 raise ValueError("stage_dtype must be float32 or bfloat16")
@@ -234,6 +249,8 @@ class NetTrainer:
         self.epoch = 0
         self._epoch_base = 0
         self._step_counter = 0
+        self._skipped_steps = 0
+        self._bad_consec = 0
 
     def _build_net(self) -> None:
         if self.batch_size <= 0:
@@ -413,6 +430,11 @@ class NetTrainer:
         metric_fns = [metric_jit.create_step_fn(name)
                       for name, _ in metric_specs]
         eval_train = bool(self.eval_train and metric_specs)
+        # captured at build time: the jitted step's return arity (2- vs
+        # 3-tuple) is baked into the compiled function, so update()
+        # must branch on what was BUILT, not on a check_nan later
+        # toggled through set_param
+        check_nan = self._check_nan_built = bool(self.check_nan)
 
         def metric_rows(outs, labels, mask, rng, base):
             """Stacked (n_metrics, 2) device rows of (sum, count); the
@@ -554,7 +576,28 @@ class NetTrainer:
                 "epoch": state["epoch"] + do_update.astype(jnp.int32),
                 "tmetric": tmetric,
             }
-            return new_state, loss
+            if not check_nan:
+                return new_state, loss
+            # divergence guard, fully in-jit: all-finite over loss,
+            # updated params, and (update_period>1) the gradient
+            # accumulator - a micro-step whose grads go NaN with a
+            # finite loss leaves params untouched, so checking params
+            # alone would commit the NaN into accum and make every
+            # retry of that update non-finite. update_period==1 skips
+            # accum: it is invariantly zero post-update and NaN grads
+            # reach params in the same step. A non-finite step selects
+            # the ENTIRE old state (params, updater state, grad accum,
+            # counters, train metrics) - a select, not a host copy
+            check_tree = {"params": new_state["params"]}
+            if update_period > 1:
+                check_tree["accum"] = new_state["accum"]
+            finite = jax.tree.reduce(
+                lambda acc, leaf: jnp.logical_and(
+                    acc, jnp.all(jnp.isfinite(leaf))),
+                check_tree, jnp.isfinite(loss))
+            new_state = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_state, state)
+            return new_state, loss, finite
 
         def eval_step(params, data, extras):
             cparams = self._cast(params)
@@ -609,7 +652,8 @@ class NetTrainer:
             train_step,
             in_shardings=(state_shardings, dshd, eshd, label_shardings,
                           shd, rep),
-            out_shardings=(state_shardings, rep),
+            out_shardings=((state_shardings, rep, rep) if check_nan
+                           else (state_shardings, rep)),
             donate_argnums=(0,))
         self._eval_step = jax.jit(
             eval_step, in_shardings=(self._pshard, dshd, eshd),
@@ -749,6 +793,16 @@ class NetTrainer:
         calls (see StagedBatch). The staging runs the exact per-step
         pipeline (pad, host cast, put under the step's in_shardings),
         so a staged update is trajectory-identical to a streamed one."""
+        if fault.fault_point("stage_batch") == "corrupt":
+            # NaN-poison the batch (fault injection): models a decode /
+            # DMA error feeding garbage into the step - the divergence
+            # guard must drop the step, not ship NaN into the weights
+            bad = np.full(np.shape(batch.data), np.nan, np.float32)
+            batch = DataBatch(
+                data=bad, label=batch.label,
+                inst_index=batch.inst_index,
+                num_batch_padd=batch.num_batch_padd,
+                extra_data=batch.extra_data)
         data, label, mask, extras = self._pad_batch(batch, train=True)
         labels = self._label_fields(label.astype(np.float32))
         shd = self._batch_sharded
@@ -799,12 +853,22 @@ class NetTrainer:
         # the step is dispatched asynchronously and train metrics
         # accumulate on device - nothing here blocks on the result, so
         # host-side input prep for batch k+1 overlaps compute of batch k
-        self.state, loss = self._train_step(
-            self.state, gdata, gextras, glabels, gmask, rng)
+        if self._check_nan_built:
+            # divergence guard: the per-step finite flag must be read
+            # back (a device sync - the cost of check_nan=1; staging
+            # prefetch still overlaps on its worker thread)
+            self.state, loss, finite = self._train_step(
+                self.state, gdata, gextras, glabels, gmask, rng)
+            self._guard_step(finite)
+        else:
+            self.state, loss = self._train_step(
+                self.state, gdata, gextras, glabels, gmask, rng)
         # host mirror of the device epoch counter (one update per
-        # update_period steps) - avoids forcing a device sync per step
-        self.epoch = self._epoch_base + (self._step_counter
-                                         // self.update_period)
+        # update_period steps) - avoids forcing a device sync per step;
+        # guard-dropped steps never advanced the device counters
+        self.epoch = self._epoch_base + (
+            (self._step_counter - self._skipped_steps)
+            // self.update_period)
         if self.profile:
             jax.block_until_ready(self.state["epoch"])
             if self.profiler is not None:
@@ -812,6 +876,29 @@ class NetTrainer:
                 # num_batch_padd would inflate images/sec
                 self.profiler.add_step(
                     _time.perf_counter() - t0, n_examples)
+
+    def _guard_step(self, finite) -> None:
+        """Host half of the divergence guard: count dropped steps and
+        abort after max_bad_rounds CONSECUTIVE non-finite steps (the
+        jitted step already rolled the state back)."""
+        ok = bool(np.asarray(distributed.fetch_local(finite)))
+        if ok:
+            self._bad_consec = 0
+            return
+        self._bad_consec += 1
+        self.bad_rounds += 1
+        self._skipped_steps += 1
+        sys.stderr.write(
+            f"divergence guard: non-finite loss/params at update "
+            f"{self._step_counter - 1}; batch dropped, params rolled "
+            f"back ({self._bad_consec}/{self.max_bad_rounds} "
+            f"consecutive)\n")
+        if self._bad_consec >= self.max_bad_rounds:
+            raise DivergenceError(
+                f"training diverged: {self._bad_consec} consecutive "
+                f"non-finite update rounds (loss or params hit NaN/Inf "
+                f"every round); lower eta or inspect the data pipeline "
+                f"- params remain at the last finite state")
 
     def update_all(self, data_iter, eval_iters=None,
                    eval_names=None) -> str:
@@ -986,6 +1073,8 @@ class NetTrainer:
         self.epoch = blob["epoch"]
         self._epoch_base = self.epoch
         self._step_counter = 0
+        self._skipped_steps = 0
+        self._bad_consec = 0
         self._loaded_opt = blob["opt_state"]
         self._build_net()
         params = jax.tree.map(jnp.asarray, blob["params"])
@@ -1009,6 +1098,8 @@ class NetTrainer:
         self.epoch = blob["epoch"]
         self._epoch_base = self.epoch
         self._step_counter = 0
+        self._skipped_steps = 0
+        self._bad_consec = 0
         params = jax.tree.map(jnp.asarray, blob["params"])
         self._init_state(params)
         self.state["epoch"] = distributed.put_global(

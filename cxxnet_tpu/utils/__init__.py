@@ -1,6 +1,10 @@
-"""Dependency-free utility layer: config parsing, metrics, binary page IO."""
+"""Dependency-free utility layer: config parsing, metrics, binary page
+IO, fault-tolerance primitives (retry / fault injection / atomic
+writes)."""
 
 from cxxnet_tpu.utils.config import ConfigIterator, parse_config_string, parse_config_file
+from cxxnet_tpu.utils.fault import (DivergenceError, InjectedFault,
+                                    atomic_writer, fault_point, retry)
 from cxxnet_tpu.utils.metric import MetricSet, create_metric
 
 __all__ = [
@@ -9,4 +13,9 @@ __all__ = [
     "parse_config_file",
     "MetricSet",
     "create_metric",
+    "DivergenceError",
+    "InjectedFault",
+    "atomic_writer",
+    "fault_point",
+    "retry",
 ]

@@ -12,7 +12,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from cxxnet_tpu.ops import attention as A
 from cxxnet_tpu.parallel import ring as R

@@ -1,0 +1,605 @@
+"""Fault-tolerance subsystem (docs/FAULT_TOLERANCE.md): retry, fault
+injection, atomic checksummed checkpoints, resume walk-back past corrupt
+files, the divergence guard, and the hardened prefetcher."""
+
+import io
+import os
+import shutil
+import struct
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.nnet import checkpoint
+from cxxnet_tpu.utils import fault
+from cxxnet_tpu.utils.fault import (InjectedFault, InjectedIOError,
+                                    atomic_writer, retry)
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+
+@pytest.fixture(autouse=True)
+def clean_registry(monkeypatch):
+    """Every test starts and ends with an empty fault registry and no
+    CXXNET_FAULT in the environment (the registry is process-global)."""
+    monkeypatch.delenv(fault.FAULT_ENV, raising=False)
+    fault.clear()
+    yield
+    fault.clear()
+
+
+# ---------------------------------------------------------------------------
+# retry decorator
+# ---------------------------------------------------------------------------
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    @retry(attempts=3, backoff=0.0, jitter=0.0)
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return 7
+
+    assert flaky() == 7
+    assert len(calls) == 3
+
+
+def test_retry_exhausts_attempts_and_raises():
+    calls = []
+
+    @retry(attempts=2, backoff=0.0, jitter=0.0)
+    def doomed():
+        calls.append(1)
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        doomed()
+    assert len(calls) == 2
+
+
+def test_retry_ignores_non_transient_errors():
+    calls = []
+
+    @retry(attempts=5, backoff=0.0, jitter=0.0, retry_on=(OSError,))
+    def broken():
+        calls.append(1)
+        raise ValueError("logic bug, not transient")
+
+    with pytest.raises(ValueError):
+        broken()
+    assert len(calls) == 1  # no retry on non-retry_on classes
+
+
+def test_retry_deadline_caps_total_wait():
+    @retry(attempts=10, backoff=30.0, jitter=0.0, deadline=0.05)
+    def slow_fail():
+        raise OSError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        slow_fail()
+    # the pending 30s backoff would blow the 0.05s deadline, so the
+    # error propagates instead of sleeping
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_retry_rejects_zero_attempts():
+    with pytest.raises(ValueError):
+        retry(attempts=0)
+
+
+# ---------------------------------------------------------------------------
+# fault-injection registry
+# ---------------------------------------------------------------------------
+def test_fault_spec_parse():
+    faults = fault.FaultRegistry.parse(
+        "save_model:crash@2, io.next:ioerror, x:delay=0.5@3")
+    assert set(faults) == {"save_model", "io.next", "x"}
+    (f,) = faults["save_model"]
+    assert (f.mode, f.at) == ("crash", 2)
+    (f,) = faults["io.next"]
+    assert (f.mode, f.at) == ("ioerror", 1)
+    (f,) = faults["x"]
+    assert (f.mode, f.arg, f.at) == ("delay", "0.5", 3)
+    with pytest.raises(ValueError):
+        fault.FaultRegistry.parse("no-colon-entry")
+
+
+def test_fault_point_fires_exactly_on_nth_hit():
+    fault.inject("p", "crash", at=2)
+    assert fault.fault_point("p") is None  # hit 1
+    with pytest.raises(InjectedFault):
+        fault.fault_point("p")             # hit 2
+    assert fault.fault_point("p") is None  # hit 3: fired once, done
+    assert fault.hits("p") == 3
+
+
+def test_fault_env_spec_is_picked_up(monkeypatch):
+    monkeypatch.setenv(fault.FAULT_ENV, "q:ioerror@1")
+    with pytest.raises(InjectedIOError):
+        fault.fault_point("q")
+
+
+def test_fault_env_unset_disarms(monkeypatch):
+    """Env-derived faults are replaced when CXXNET_FAULT changes and
+    disarmed when it is unset - no ghost faults."""
+    monkeypatch.setenv(fault.FAULT_ENV, "z:crash@2")
+    assert fault.fault_point("z") is None  # hit 1: spec parsed, armed
+    monkeypatch.delenv(fault.FAULT_ENV)
+    assert fault.fault_point("z") is None  # hit 2: disarmed, no crash
+    monkeypatch.setenv(fault.FAULT_ENV, "other:crash@9")
+    assert fault.fault_point("z") is None  # hit 3: replaced, not stacked
+
+
+def test_fault_site_handled_mode_returned():
+    fault.inject("s", "corrupt")
+    assert fault.fault_point("s") == "corrupt"
+    assert fault.fault_point("s") is None
+
+
+def test_fault_kill_mode_exits_process():
+    env = dict(os.environ, CXXNET_FAULT="x:kill@1", JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-c",
+         "from cxxnet_tpu.utils import fault; fault.fault_point('x'); "
+         "print('survived')"],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=120)
+    assert p.returncode == fault.KILL_EXIT_CODE, (p.stdout, p.stderr)
+    assert "survived" not in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# atomic_writer
+# ---------------------------------------------------------------------------
+def test_atomic_writer_success(tmp_path):
+    path = str(tmp_path / "out.txt")
+    with atomic_writer(path, "w") as fo:
+        fo.write("hello")
+    assert open(path).read() == "hello"
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_atomic_writer_error_preserves_previous_content(tmp_path):
+    path = str(tmp_path / "out.txt")
+    with open(path, "w") as fo:
+        fo.write("old")
+    with pytest.raises(RuntimeError):
+        with atomic_writer(path, "w") as fo:
+            fo.write("half-writ")
+            raise RuntimeError("crash mid-write")
+    assert open(path).read() == "old"  # untouched
+    assert not os.path.exists(path + ".tmp")  # tmp cleaned up
+
+
+# ---------------------------------------------------------------------------
+# checkpoint trailer + validate_file
+# ---------------------------------------------------------------------------
+def _tiny_blob(opt=None):
+    params = {"fc1": {"wmat": np.arange(12, dtype=np.float32)
+                      .reshape(3, 4),
+                      "bias": np.zeros(4, np.float32)}}
+    bio = io.BytesIO()
+    checkpoint.save_model(bio, 0, {"layers": []}, 5, params, opt)
+    return params, bio.getvalue()
+
+
+def test_checkpoint_roundtrip_validates_trailer():
+    params, blob = _tiny_blob()
+    assert blob.endswith(
+        struct.pack("<I", __import__("zlib").crc32(
+            blob[:-checkpoint.TRAILER_LEN])))
+    assert checkpoint.TRAILER_MAGIC in blob[-checkpoint.TRAILER_LEN:]
+    out = checkpoint.load_model(io.BytesIO(blob))
+    assert out["epoch"] == 5
+    np.testing.assert_array_equal(out["params"]["fc1"]["wmat"],
+                                  params["fc1"]["wmat"])
+
+
+def test_checkpoint_truncated_blob_rejected():
+    _, blob = _tiny_blob()
+    with pytest.raises(ValueError, match="truncated"):
+        checkpoint.load_model(io.BytesIO(blob[:len(blob) // 2]))
+
+
+def test_checkpoint_bad_magic_rejected():
+    _, blob = _tiny_blob()
+    with pytest.raises(ValueError, match="bad magic"):
+        checkpoint.load_model(io.BytesIO(b"XXXXXXXX" + blob[8:]))
+
+
+def test_checkpoint_flipped_payload_byte_rejected():
+    _, blob = _tiny_blob()
+    # corrupt one byte inside the array payload (before the trailer):
+    # the arrays still parse - only the crc trailer catches this
+    i = len(blob) - checkpoint.TRAILER_LEN - 3
+    bad = blob[:i] + bytes([blob[i] ^ 0xFF]) + blob[i + 1:]
+    with pytest.raises(ValueError, match="crc32 mismatch"):
+        checkpoint.load_model(io.BytesIO(bad))
+
+
+def test_checkpoint_pre_trailer_files_still_load():
+    params, blob = _tiny_blob()
+    legacy = blob[:-checkpoint.TRAILER_LEN]  # file from before the format
+    out = checkpoint.load_model(io.BytesIO(legacy))
+    np.testing.assert_array_equal(out["params"]["fc1"]["wmat"],
+                                  params["fc1"]["wmat"])
+
+
+def test_validate_file(tmp_path):
+    _, blob = _tiny_blob()
+    good = tmp_path / "good.model"
+    good.write_bytes(blob)
+    assert checkpoint.validate_file(str(good)) is None
+
+    i = len(blob) - checkpoint.TRAILER_LEN - 3
+    corrupt = tmp_path / "corrupt.model"
+    corrupt.write_bytes(blob[:i] + bytes([blob[i] ^ 0xFF]) + blob[i + 1:])
+    assert "crc32 mismatch" in checkpoint.validate_file(str(corrupt))
+
+    trunc = tmp_path / "trunc.model"
+    trunc.write_bytes(blob[:len(blob) // 2])
+    assert checkpoint.validate_file(str(trunc)) is not None
+
+    empty = tmp_path / "empty.model"
+    empty.write_bytes(b"")
+    assert "short" in checkpoint.validate_file(str(empty))
+
+    foreign = tmp_path / "foreign.model"  # legacy-format: not checkable
+    foreign.write_bytes(b"\x00" * 64)
+    assert checkpoint.validate_file(str(foreign)) is None
+
+
+def test_corrupt_mode_writes_invalid_blob(tmp_path):
+    """save_model's `corrupt` fault action emits a structurally
+    truncated, trailer-less blob - exactly what load must reject."""
+    fault.inject("save_model", "corrupt")
+    _, blob = _tiny_blob()
+    assert checkpoint.TRAILER_MAGIC not in blob[-checkpoint.TRAILER_LEN:]
+    with pytest.raises(ValueError):
+        checkpoint.load_model(io.BytesIO(blob))
+
+
+# ---------------------------------------------------------------------------
+# CLI: durable saves, resume walk-back, divergence guard (e2e)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def dataset(tmp_path):
+    from test_cli import write_conf, write_synth_mnist
+    tr = write_synth_mnist(tmp_path, n=256, seed=0, prefix="train")
+    te = write_synth_mnist(tmp_path, n=64, seed=1, prefix="test")
+    return tmp_path, write_conf(tmp_path, *tr, *te)
+
+
+def run_cli(conf, *extra, faults=None, timeout=480):
+    """Drive the real CLI in a fresh process. Each e2e scenario runs
+    python -m cxxnet_tpu.main rather than LearnTask in-process: that is
+    what production resume actually is (a NEW process finding whatever
+    the dead one left on disk), it lets the kill/crash faults take the
+    whole process without taking pytest, and it sidesteps a jax-cpu
+    flake (rare silent SIGABRT in device_put) seen only in long-lived
+    many-jit processes - never in fresh ones."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(fault.FAULT_ENV, None)
+    if faults:
+        env[fault.FAULT_ENV] = faults
+    return subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu.main", str(conf), *extra],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def final_test_error(stderr: str) -> float:
+    final = [l for l in stderr.splitlines() if "test-error" in l][-1]
+    return float(final.split("test-error:")[-1].split("\t")[0])
+
+
+def test_crash_mid_save_leaves_no_partial_final_file(dataset):
+    """A crash INSIDE the checkpoint write (the save_model fault point
+    is mid-payload) must leave %04d.model either complete or absent -
+    never truncated."""
+    tmp_path, conf = dataset
+    p = run_cli(conf, faults="save_model:crash@2")  # 2nd save = 0001
+    assert p.returncode != 0
+    assert "InjectedFault" in p.stderr, p.stderr
+    models = tmp_path / "models"
+    assert checkpoint.validate_file(str(models / "0000.model")) is None
+    assert not os.path.exists(models / "0001.model")
+    # atomic_writer removed the tmp on the way out (crash = exception;
+    # only a hard kill can leave *.tmp debris)
+    assert not list(models.glob("*.tmp"))
+
+
+def test_kill_mid_save_then_resume_from_last_valid(dataset):
+    """THE acceptance scenario: a run corrupted at save #3 and KILLED
+    mid-write at save #4 resumes via continue=1 from the last valid
+    checkpoint - the corrupt file is skipped and logged, the partial
+    write never became a *.model file."""
+    tmp_path, conf = dataset
+    p = run_cli(conf, faults="save_model:corrupt@3,save_model:kill@4")
+    assert p.returncode == fault.KILL_EXIT_CODE, (p.stdout, p.stderr)
+
+    models = tmp_path / "models"
+    # saves: hit1=0000 ok, hit2=0001 ok, hit3=0002 corrupt (atomically
+    # published, crc-invalid), hit4=0003 killed mid-tmp-write
+    assert checkpoint.validate_file(str(models / "0000.model")) is None
+    assert checkpoint.validate_file(str(models / "0001.model")) is None
+    assert checkpoint.validate_file(str(models / "0002.model")) is not None
+    assert not os.path.exists(models / "0003.model")
+    assert list(models.glob("*.tmp")), "kill mid-write leaves the tmp"
+
+    p = run_cli(conf, "continue=1")
+    assert p.returncode == 0, p.stderr
+    assert "skipping invalid checkpoint" in p.stderr
+    assert "0002.model" in p.stderr
+    assert "Continue training from round 2" in p.stdout
+    # the lost rounds were retrained; the full run completed validly
+    for c in range(2, 7):
+        assert checkpoint.validate_file(
+            str(models / f"{c:04d}.model")) is None
+    assert final_test_error(p.stderr) < 0.15
+
+
+def test_injected_nan_batch_skipped_not_aborted(dataset):
+    """Acceptance: one NaN-poisoned batch with check_nan=1 costs one
+    dropped step, not the run."""
+    tmp_path, conf = dataset
+    p = run_cli(conf, "check_nan=1", "num_round=4",
+                faults="stage_batch:corrupt@5")
+    assert p.returncode == 0, p.stderr
+    assert "divergence guard: non-finite" in p.stderr
+    assert "batch dropped, params rolled back" in p.stderr
+    # exactly one dropped round (NetTrainer.bad_rounds == 1)
+    drops = [l for l in p.stderr.splitlines()
+             if "divergence guard: non-finite" in l]
+    assert len(drops) == 1
+    # training completed through round 4 and still converged
+    assert os.path.exists(tmp_path / "models" / "0004.model")
+    assert final_test_error(p.stderr) < 0.2
+
+
+def test_divergence_abort_saves_rescue_checkpoint(dataset):
+    tmp_path, conf = dataset
+    p = run_cli(
+        conf, "check_nan=1", "max_bad_rounds=3",
+        faults="stage_batch:corrupt@2,stage_batch:corrupt@3,"
+               "stage_batch:corrupt@4")
+    assert p.returncode != 0
+    assert "DivergenceError" in p.stderr, p.stderr
+    assert "training diverged" in p.stderr
+    rescue = tmp_path / "models" / "rescue.model"
+    assert "rescue checkpoint" in p.stderr
+    assert rescue.exists()
+    assert checkpoint.validate_file(str(rescue)) is None
+
+
+def test_load_model_unparseable_name_never_overwrites(dataset):
+    """start_counter fallback: model_in with a name the %04d parse
+    rejects defaults to one past the NEWEST checkpoint, so the next
+    save cannot clobber an existing file."""
+    tmp_path, conf = dataset
+    assert run_cli(conf, "num_round=3").returncode == 0
+    models = tmp_path / "models"
+    shutil.copy(models / "0002.model", models / "latest.model")
+    newest_bytes = (models / "0003.model").read_bytes()
+    p = run_cli(conf, f"model_in={models}/latest.model", "num_round=4")
+    assert p.returncode == 0, p.stderr
+    assert "cannot infer start_counter" in p.stdout
+    assert (models / "0004.model").exists()
+    assert (models / "0003.model").read_bytes() == newest_bytes
+
+
+def test_keep_latest_rotation_then_resume(dataset):
+    """keep_latest bounds the checkpoint set, and continue=1 still
+    finds the survivors (the resume scan is listdir-based, not an
+    ascending existence probe from 0000)."""
+    tmp_path, conf = dataset
+    assert run_cli(conf, "keep_latest=2").returncode == 0
+    kept = sorted(p.name for p in (tmp_path / "models").glob("*.model"))
+    assert kept == ["0005.model", "0006.model"]
+    p = run_cli(conf, "continue=1", "num_round=8")
+    assert p.returncode == 0, p.stderr
+    assert "Continue training from round 7" in p.stdout
+    assert (tmp_path / "models" / "0008.model").exists()
+
+
+def test_io_retry_absorbs_transient_error(dataset, capfd):
+    """An injected transient IO error inside the data pipeline is
+    retried by the RetryIterator wrapper - the epoch still serves every
+    batch."""
+    from cxxnet_tpu.io import RetryIterator, create_iterator
+    from cxxnet_tpu.utils.config import parse_config_string
+    tmp_path, _ = dataset
+    it = create_iterator(parse_config_string(f"""
+iter = mnist
+path_img = "{tmp_path}/train-img.gz"
+path_label = "{tmp_path}/train-lbl.gz"
+batch_size = 32
+input_flat = 1
+"""))
+    assert isinstance(it, RetryIterator)
+    it.set_param("io_retry_backoff", "0.0")
+    it.init()
+    fault.inject("io.next", "ioerror", at=3)
+    n = 0
+    it.before_first()
+    while it.next():
+        n += 1
+    assert n == 256 // 32  # all batches served despite the fault
+    assert fault.hits("io.next") >= 9  # the failed hit was re-driven
+    assert "retry:" in capfd.readouterr().err
+
+
+def test_io_retry_inside_threadbuffer_producer(dataset):
+    """A transient IO error under iter=threadbuffer is retried INSIDE
+    the producer thread: by the time it reaches the consumer it is a
+    RuntimeError from a dead producer, which no outer retry can absorb."""
+    from cxxnet_tpu.io import create_iterator
+    from cxxnet_tpu.io.iter_batch import ThreadBufferIterator
+    from cxxnet_tpu.utils.config import parse_config_string
+    tmp_path, _ = dataset
+    it = create_iterator(parse_config_string(f"""
+iter = mnist
+path_img = "{tmp_path}/train-img.gz"
+path_label = "{tmp_path}/train-lbl.gz"
+batch_size = 32
+input_flat = 1
+silent = 1
+iter = threadbuffer
+io_retry_backoff = 0.0
+"""))
+    assert isinstance(it, ThreadBufferIterator)  # no useless outer wrap
+    it.init()
+    fault.inject("io.next", "ioerror", at=3)
+    n = 0
+    it.before_first()
+    while it.next():
+        n += 1
+    assert n == 256 // 32  # all batches served despite the fault
+    assert fault.hits("io.next") >= 9  # the failed hit was re-driven
+
+
+def test_check_nan_update_period_detects_nan_accum():
+    """update_period>1: the divergence guard must check the gradient
+    ACCUMULATOR, not just loss+params - on a non-update micro-step
+    params are untouched and loss is finite, so a NaN entering accum
+    would otherwise be committed and poison every retry of that
+    update."""
+    import jax
+    import jax.numpy as jnp
+    from test_trainer import make_trainer, synth_batches
+    t = make_trainer(extra="update_period = 2\ncheck_nan = 1\n")
+    batches = synth_batches(2)
+    # poison one committed accumulator leaf (count=0: the next update
+    # is a non-update micro-step - params stay untouched, loss finite)
+    for lk in t.state["accum"]:
+        for pn in t.state["accum"][lk]:
+            leaf = t.state["accum"][lk][pn]
+            t.state["accum"][lk][pn] = jax.device_put(
+                jnp.full(leaf.shape, jnp.nan, leaf.dtype), leaf.sharding)
+            break
+        break
+    t.update(batches[0])
+    assert t.bad_rounds == 1  # caught on the micro-step, not later
+
+
+def test_io_retry_keys_in_iterator_block_reach_wrapper(dataset):
+    """io_retry / io_retry_backoff inside the `iter = ...` block must
+    configure the RetryIterator even though the wrapper is created
+    after the block params are applied."""
+    from cxxnet_tpu.io import create_iterator
+    from cxxnet_tpu.utils.config import parse_config_string
+    tmp_path, _ = dataset
+    it = create_iterator(parse_config_string(f"""
+iter = mnist
+path_img = "{tmp_path}/train-img.gz"
+path_label = "{tmp_path}/train-lbl.gz"
+io_retry = 7
+io_retry_backoff = 0.01
+batch_size = 32
+"""))
+    assert it.attempts == 7
+    assert it.backoff == 0.01
+
+
+def test_model_counter_regex_handles_five_digits(tmp_path):
+    """%04d renders 5 digits past round 9999: rotation and the
+    start_counter fallback must still see those files."""
+    from cxxnet_tpu.main import LearnTask
+    lt = LearnTask()
+    lt.name_model_dir = str(tmp_path)
+    for name in ("9998.model", "9999.model", "10000.model"):
+        (tmp_path / name).write_bytes(b"x")
+    assert lt._newest_model_counter() == 10000
+    lt.keep_latest = 2
+    lt._rotate_models(10000)
+    left = sorted(p.name for p in tmp_path.glob("*.model"))
+    assert left == ["10000.model", "9999.model"]
+
+
+def test_rotation_ignores_stale_higher_counters(tmp_path):
+    """A stale higher-counter file (corrupt debris a resume walked
+    back over) must not push the just-saved checkpoint out of the
+    keep_latest window."""
+    from cxxnet_tpu.main import LearnTask
+    lt = LearnTask()
+    lt.name_model_dir = str(tmp_path)
+    lt.keep_latest = 1
+    for name in ("0002.model", "0003.model", "0005.model"):
+        (tmp_path / name).write_bytes(b"x")
+    lt._rotate_models(3)  # just saved 0003; 0005 is stale debris
+    left = sorted(p.name for p in tmp_path.glob("*.model"))
+    assert left == ["0003.model", "0005.model"]
+
+
+# ---------------------------------------------------------------------------
+# prefetcher hardening
+# ---------------------------------------------------------------------------
+class _ListSource:
+    def __init__(self, items):
+        self.items = items
+        self.i = -1
+
+    def before_first(self):
+        self.i = -1
+
+    def next(self):
+        self.i += 1
+        return self.i < len(self.items)
+
+    def value(self):
+        return self.items[self.i]
+
+
+def test_prefetcher_detects_dead_worker(monkeypatch):
+    from cxxnet_tpu.io.prefetch import StagedPrefetcher
+    monkeypatch.setattr(StagedPrefetcher, "_run", lambda self: None)
+    pf = StagedPrefetcher(lambda b: b, _ListSource([1, 2, 3]), depth=1)
+    pf.before_first()
+    with pytest.raises(RuntimeError, match="worker died"):
+        pf.next()
+    assert not pf.next()  # dead pass stays dead, no hang
+    pf.close()
+
+
+def test_prefetcher_close_surfaces_pending_worker_error():
+    class Boom(_ListSource):
+        def value(self):
+            if self.i == 1:
+                raise RuntimeError("decode failed late")
+            return self.items[self.i]
+
+    from cxxnet_tpu.io.prefetch import StagedPrefetcher
+    pf = StagedPrefetcher(lambda b: b, Boom([1, 2, 3]), depth=2)
+    pf.before_first()
+    assert pf.next()          # item 1 delivered
+    pf._thread.join(timeout=10)  # worker queued its error and exited
+    with pytest.raises(RuntimeError, match="decode failed late"):
+        pf.close()            # undelivered error surfaces, not dropped
+    pf.close()                # idempotent: surfaced errors don't repeat
+
+
+def test_prefetcher_close_does_not_mask_consumer_error(capfd):
+    class Boom(_ListSource):
+        def value(self):
+            if self.i == 1:
+                raise RuntimeError("worker error")
+            return self.items[self.i]
+
+    from cxxnet_tpu.io.prefetch import StagedPrefetcher
+    pf = StagedPrefetcher(lambda b: b, Boom([1, 2, 3]), depth=2)
+    pf.before_first()
+    assert pf.next()
+    pf._thread.join(timeout=10)
+    with pytest.raises(ValueError, match="consumer bug"):
+        try:
+            raise ValueError("consumer bug")
+        except ValueError:
+            pf.close()  # must not replace the in-flight error
+            raise
+    assert "superseded by the consumer" in capfd.readouterr().err

@@ -12,7 +12,6 @@ path - only the collective pattern changes.
 """
 
 import numpy as np
-import pytest
 
 import jax
 
