@@ -19,6 +19,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.data import DataInst
 from cxxnet_tpu.io.iterators import DataIter
 
@@ -245,7 +246,8 @@ class AugmentIterator(DataIter):
         if self.name_meanimg:
             if os.path.exists(self.name_meanimg):
                 if not self.silent:
-                    print(f"loading mean image from {self.name_meanimg}")
+                    telemetry.stdout(
+                        f"loading mean image from {self.name_meanimg}")
                 self.meanimg = load_mean_image(self.name_meanimg)
             else:
                 self._create_mean_img()
@@ -337,8 +339,9 @@ class AugmentIterator(DataIter):
 
     def _create_mean_img(self) -> None:
         if not self.silent:
-            print(f"cannot find {self.name_meanimg}: creating mean image, "
-                  "this will take some time...")
+            telemetry.stdout(
+                f"cannot find {self.name_meanimg}: creating mean image, "
+                "this will take some time...")
         # accumulate the *processed* instances exactly like CreateMeanImg
         # (meanimg is None here so _set_data performs no subtraction)
         self.base.before_first()

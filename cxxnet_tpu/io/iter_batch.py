@@ -16,6 +16,7 @@ from typing import Optional
 
 import numpy as np
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.data import DataBatch, DataInst
 from cxxnet_tpu.io.iterators import DataIter
 from cxxnet_tpu.io.thread_util import drain_and_join, stoppable_put
@@ -140,7 +141,8 @@ class ThreadBufferIterator(DataIter):
     def init(self) -> None:
         self.base.init()
         if not self.silent:
-            print(f"ThreadBufferIterator: buffer_size={self.buffer_size}")
+            telemetry.stdout(
+                f"ThreadBufferIterator: buffer_size={self.buffer_size}")
 
     def _producer(self, q: "queue.Queue", stop: threading.Event) -> None:
         try:

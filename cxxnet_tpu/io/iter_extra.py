@@ -12,6 +12,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.data import DataBatch
 from cxxnet_tpu.io.iterators import DataIter
 
@@ -98,8 +99,9 @@ class AttachTxtIterator(DataIter):
                 self._table[idx] = feats
                 self._width = max(self._width, len(feats))
         if not self.silent:
-            print(f"AttachTxtIterator: {len(self._table)} rows of width "
-                  f"{self._width}")
+            telemetry.stdout(
+                f"AttachTxtIterator: {len(self._table)} rows of width "
+                f"{self._width}")
 
     def before_first(self) -> None:
         self.base.before_first()

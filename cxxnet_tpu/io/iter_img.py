@@ -21,6 +21,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.data import DataInst
 from cxxnet_tpu.io.iterators import DataIter
 from cxxnet_tpu.io.thread_util import drain_and_join, stoppable_put
@@ -106,8 +107,8 @@ class ImageIterator(DataIter):
         self.entries = entries
         self.order = list(range(len(self.entries)))
         if not self.silent:
-            print(f"ImageIterator: {self.path_imglist}, "
-                  f"{len(self.entries)} images")
+            telemetry.stdout(f"ImageIterator: {self.path_imglist}, "
+                             f"{len(self.entries)} images")
         self.before_first()
 
     def before_first(self) -> None:
@@ -264,8 +265,9 @@ class ImageBinIterator(DataIter):
                 len(self.entries), self._shard_nw, self.dist_worker_rank)
         if not self.silent:
             mode = "native" if self._native_mode else "python"
-            print(f"ImageBinIterator: {len(self.entries)} images from "
-                  f"{len(bins)} bins ({mode} decode)")
+            telemetry.stdout(
+                f"ImageBinIterator: {len(self.entries)} images from "
+                f"{len(bins)} bins ({mode} decode)")
         self.before_first()
 
     def before_first(self) -> None:

@@ -13,6 +13,7 @@ import struct
 
 import numpy as np
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.data import DataBatch
 from cxxnet_tpu.io.iterators import DataIter
 
@@ -91,8 +92,8 @@ class MNISTIterator(DataIter):
         self.loc = 0
         if not self.silent:
             s = (self.batch_size,) + self.data.shape[1:]
-            print(f"MNISTIterator: load {len(labels)} images, "
-                  f"shuffle={self.shuffle}, shape={s}")
+            telemetry.stdout(f"MNISTIterator: load {len(labels)} images, "
+                             f"shuffle={self.shuffle}, shape={s}")
 
     def before_first(self) -> None:
         self.loc = 0

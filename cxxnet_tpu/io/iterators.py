@@ -72,9 +72,18 @@ class RetryIterator(DataIter):
         self.inner.init()
 
     def _build(self) -> None:
-        from cxxnet_tpu.utils.fault import fault_point, retry
+        from cxxnet_tpu import telemetry
+        from cxxnet_tpu.utils.fault import (
+            default_on_retry, fault_point, retry)
+
+        def notify(fn, attempt, total, exc, sleep_s):
+            # io-scoped retry count alongside the global fault.retry
+            # counter/event the shared notifier keeps (same stderr text)
+            telemetry.inc("io.retry")
+            default_on_retry(fn, attempt, total, exc, sleep_s)
+
         deco = retry(attempts=self.attempts, backoff=self.backoff,
-                     retry_on=(OSError,))
+                     retry_on=(OSError,), on_retry=notify)
 
         def raw_next():
             fault_point("io.next")

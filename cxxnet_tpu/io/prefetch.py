@@ -23,7 +23,9 @@ from __future__ import annotations
 import queue
 import sys
 import threading
+import time
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.thread_util import drain_and_join
 
 _END = object()
@@ -49,6 +51,10 @@ class StagedPrefetcher:
         self._exhausted = False
         self._closed = False
         self._pending_error = None
+        # telemetry armed? cached per pass (before_first) - the
+        # disabled next() path must cost one attribute check, not a
+        # singleton lookup per batch
+        self._tel = False
 
     # -- DataIter protocol -------------------------------------------------
     def before_first(self) -> None:
@@ -58,6 +64,7 @@ class StagedPrefetcher:
         # re-raise on this pass)
         self._pending_error = None
         self.source.before_first()
+        self._tel = telemetry.enabled()
         self._q = queue.Queue(maxsize=self.depth)
         self._stop.clear()
         self._exhausted = False
@@ -78,11 +85,16 @@ class StagedPrefetcher:
             # the worker put ONE _END and exited; a blocking get here
             # would hang forever
             return False
+        t0 = time.perf_counter() if self._tel else 0.0
+        stalled = False
         while True:
             try:
                 item = self._q.get(timeout=0.2)
                 break
             except queue.Empty:
+                # the staging worker is behind the consumer: the train
+                # loop is data-bound right now (prefetch stall)
+                stalled = True
                 if self._thread is not None and self._thread.is_alive():
                     continue
                 # worker died without delivering a batch, _END, or an
@@ -105,8 +117,16 @@ class StagedPrefetcher:
             # that catches it and calls next() again must get False,
             # not a hang on a dead producer's queue
             self._exhausted = True
+            telemetry.inc("io.prefetch.worker_errors")
             raise item
         self._cur = item
+        if self._tel:
+            telemetry.inc("io.prefetch.batches")
+            telemetry.set_gauge("io.prefetch.depth", self._q.qsize())
+            wait = time.perf_counter() - t0
+            telemetry.observe("io.prefetch.wait_s", wait)
+            if stalled:
+                telemetry.inc("io.prefetch.stalls")
         return True
 
     def value(self):
@@ -132,9 +152,11 @@ class StagedPrefetcher:
         if err is not None:
             if sys.exc_info()[1] is None:
                 raise err
-            sys.stderr.write(
+            telemetry.stderr(
                 f"staged-prefetch: worker error superseded by the "
-                f"consumer's: {type(err).__name__}: {err}\n")
+                f"consumer's: {type(err).__name__}: {err}\n",
+                event_kind="io", type="prefetch_worker_error_superseded",
+                error=f"{type(err).__name__}: {err}")
 
     # -- worker ------------------------------------------------------------
     def _put(self, item) -> bool:

@@ -24,10 +24,29 @@ import sys
 import time
 from typing import List, Optional, Tuple
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.io import create_iterator
 from cxxnet_tpu.nnet.trainer import NetTrainer
 from cxxnet_tpu.utils.config import parse_config_file
 from cxxnet_tpu.utils.fault import DivergenceError, atomic_writer
+
+
+def _eval_values(text: str) -> dict:
+    """Parse a reference-format eval string ('\\tname-metric:value'
+    repeated) into {name-metric: float} for structured eval events.
+    Unparseable tokens are skipped - the event is best-effort, the
+    stderr text is the ground truth."""
+    out = {}
+    for tok in text.split("\t"):
+        tok = tok.strip()
+        if not tok or ":" not in tok:
+            continue
+        key, _, val = tok.rpartition(":")
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
 
 
 class LearnTask:
@@ -59,6 +78,13 @@ class LearnTask:
         self.print_step = 100
         self.extract_node_name = ""
         self.output_format = 1
+        # telemetry sinks (docs/OBSERVABILITY.md): empty = disabled,
+        # and the CLI's stdout/stderr stay byte-identical to the
+        # pre-telemetry behavior
+        self.log_file = ""
+        self.metrics_file = ""
+        self.log_format = "json"
+        self.heartbeat_secs = 0.0
         self.device = "tpu"
         self.eval_train = 1
         self.test_on_server = 0
@@ -67,7 +93,7 @@ class LearnTask:
     # ------------------------------------------------------------------
     def run(self, argv: List[str]) -> int:
         if len(argv) < 1:
-            print("Usage: <config> [k=v ...]")
+            telemetry.stdout("Usage: <config> [k=v ...]")
             return 0
         for name, val in parse_config_file(argv[0]):
             self.set_param(name, val)
@@ -90,20 +116,44 @@ class LearnTask:
                 jax.config.update("jax_platforms", "cpu")
             except RuntimeError:
                 pass  # backend already initialized
-        self.init()
-        if not self.silent:
-            print("initializing end, start working")
-        if self.task in ("train", "finetune"):
-            self.task_train()
-        elif self.task == "pred":
-            self.task_predict()
-        elif self.task == "pred_raw":
-            self.task_predict_raw()
-        elif self.task == "extract":
-            self.task_extract_feature()
-        else:
-            raise ValueError(f"unknown task {self.task}")
-        return 0
+        # arm telemetry before init() so resume walk-backs and model
+        # loads are already on the record; with no sink keys set this
+        # returns the process to the disabled (byte-parity) state
+        telemetry.configure(
+            log_file=self.log_file, metrics_file=self.metrics_file,
+            log_format=self.log_format,
+            heartbeat_secs=self.heartbeat_secs,
+            tags={"device": self.device})
+        telemetry.event("run_start", task=self.task, conf=argv[0],
+                        num_round=self.num_round)
+        t_run = time.monotonic()
+        try:
+            self.init()
+            if telemetry.enabled():
+                # distributed init (if any) happened inside init():
+                # refine the process tag so multi-host streams merge
+                import jax
+                telemetry.set_tags(proc=jax.process_index())
+            if not self.silent:
+                telemetry.stdout("initializing end, start working")
+            if self.task in ("train", "finetune"):
+                self.task_train()
+            elif self.task == "pred":
+                self.task_predict()
+            elif self.task == "pred_raw":
+                self.task_predict_raw()
+            elif self.task == "extract":
+                self.task_extract_feature()
+            else:
+                raise ValueError(f"unknown task {self.task}")
+            return 0
+        finally:
+            # final snapshot + clean close even on an aborting task, so
+            # the stream explains the crash (heartbeat stops with it)
+            telemetry.event("run_end", task=self.task,
+                            secs=time.monotonic() - t_run)
+            telemetry.emit_metrics(kind="final", task=self.task)
+            telemetry.close()
 
     def set_param(self, name: str, val: str) -> None:
         if val == "default":
@@ -148,6 +198,14 @@ class LearnTask:
             self.extract_node_name = val
         if name == "output_format":
             self.output_format = 1 if val == "txt" else 0
+        if name == "log_file":
+            self.log_file = val
+        if name == "metrics_file":
+            self.metrics_file = val
+        if name == "log_format":
+            self.log_format = val
+        if name == "heartbeat_secs":
+            self.heartbeat_secs = float(val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -287,8 +345,10 @@ class LearnTask:
         distributed.init_from_config(self.cfg)
         if self.task == "train" and self.continue_training:
             if self._sync_latest_model():
-                print(f"Init: Continue training from round "
-                      f"{self.start_counter}")
+                telemetry.stdout(f"Init: Continue training from round "
+                                 f"{self.start_counter}")
+                telemetry.event("checkpoint", op="resume",
+                                round=self.start_counter)
                 self._create_iterators()
                 return
             # reference aborts here (cxxnet_main.cpp:109-113)
@@ -336,6 +396,7 @@ class LearnTask:
         while counters:
             c = counters.pop()
             path = self._model_name(c)
+            t0 = time.perf_counter()
             err = checkpoint.validate_file(path)
             if err is None:
                 try:
@@ -351,9 +412,17 @@ class LearnTask:
                     err = str(e)
                     self.net_trainer = None
             if err is not None:
-                sys.stderr.write(
-                    f"Init: skipping invalid checkpoint {path}: {err}\n")
+                # crc-skip walk-back: countable, not just a stderr line
+                telemetry.inc("checkpoint.walkback")
+                telemetry.stderr(
+                    f"Init: skipping invalid checkpoint {path}: {err}\n",
+                    event_kind="checkpoint", op="skip_invalid",
+                    path=path, error=err)
                 continue
+            secs = time.perf_counter() - t0
+            telemetry.observe("checkpoint.load_s", secs)
+            telemetry.event("checkpoint", op="load", path=path,
+                            round=c, secs=secs)
             # the next save overwrites the first invalid/missing slot,
             # re-training the lost rounds
             self.start_counter = c + 1
@@ -376,12 +445,18 @@ class LearnTask:
             newest = self._newest_model_counter()
             self.start_counter = (newest + 1 if newest is not None
                                   else self.start_counter + 1)
-            print(f"WARNING: cannot infer start_counter from model name; "
-                  f"using {self.start_counter} (one past the newest "
-                  f"checkpoint in {self.name_model_dir})")
+            telemetry.stdout(
+                f"WARNING: cannot infer start_counter from model name; "
+                f"using {self.start_counter} (one past the newest "
+                f"checkpoint in {self.name_model_dir})")
         self.net_trainer = self._create_net()
+        t0 = time.perf_counter()
         with open(self.name_model_in, "rb") as fi:
             self.net_trainer.load_model(fi)
+        secs = time.perf_counter() - t0
+        telemetry.observe("checkpoint.load_s", secs)
+        telemetry.event("checkpoint", op="load", path=self.name_model_in,
+                        secs=secs)
 
     def _copy_model(self) -> None:
         self.net_trainer = self._create_net()
@@ -399,10 +474,23 @@ class LearnTask:
         if self.save_period == 0 or self.start_counter % self.save_period:
             return
         os.makedirs(self.name_model_dir, exist_ok=True)
+        path = self._model_name(counter)
+        t0 = time.perf_counter()
         # durable save: tmp + fsync + os.replace, so a kill mid-write
         # leaves at most a *.tmp - %04d.model is complete or absent
-        with atomic_writer(self._model_name(counter)) as fo:
+        with atomic_writer(path) as fo:
             self.net_trainer.save_model(fo)
+        # end-to-end save cost incl. fsync + rename (serialization-only
+        # time is checkpoint.write_s, kept by nnet/checkpoint.py)
+        secs = time.perf_counter() - t0
+        telemetry.inc("checkpoint.saves")
+        telemetry.observe("checkpoint.save_s", secs)
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = -1
+        telemetry.event("checkpoint", op="save", round=counter,
+                        path=path, secs=secs, bytes=nbytes)
         self._rotate_models(counter)
 
     def _rotate_models(self, saved: int) -> None:
@@ -475,19 +563,25 @@ class LearnTask:
 
     # ------------------------------------------------------------------
     def task_train(self) -> None:
-        start = time.time()
+        # monotonic: elapsed reporting must survive NTP step/slew of
+        # the wall clock (a backwards jump under time.time() printed
+        # negative/garbage durations)
+        start = time.monotonic()
         if self.continue_training == 0 and self.name_model_in == "NULL":
             self._save_model()
         else:
-            for it, name in zip(self.itr_evals, self.eval_names):
-                sys.stderr.write(self.net_trainer.evaluate(it, name))
-            sys.stderr.write("\n")
+            line = "".join(self.net_trainer.evaluate(it, name)
+                           for it, name in zip(self.itr_evals,
+                                               self.eval_names))
+            telemetry.stderr(line + "\n", event_kind="eval",
+                            round=self.start_counter - 1,
+                            values=_eval_values(line))
             sys.stderr.flush()
 
         if self.itr_train is None:
             return
         if self.test_io:
-            print("start I/O test")
+            telemetry.stdout("start I/O test")
         cc = self.max_round
         try:
             self._train_rounds(cc, start)
@@ -495,22 +589,28 @@ class LearnTask:
             # abort, but not empty-handed: the state is the last good
             # (rolled-back) params - worth a rescue checkpoint
             path = self._save_rescue()
-            sys.stderr.write(
+            telemetry.inc("fault.divergence_abort")
+            telemetry.stderr(
                 f"divergence guard: training aborted; rescue checkpoint "
-                f"saved to {path}\n")
+                f"saved to {path}\n",
+                event_kind="fault", type="divergence_abort",
+                rescue=path)
             raise
         final_profile = self.net_trainer.profile_summary()
         if final_profile:
-            sys.stderr.write(final_profile + "\n")
+            telemetry.stderr(final_profile + "\n")
             sys.stderr.flush()
         if not self.silent:
-            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+            telemetry.stdout(
+                f"\nupdating end, {int(time.monotonic() - start)} "
+                "sec in all")
 
     def _train_rounds(self, cc: int, start: float) -> None:
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
             if not self.silent:
-                print(f"update round {self.start_counter - 1}")
+                telemetry.stdout(f"update round {self.start_counter - 1}")
+            telemetry.event("round_start", round=self.start_counter)
             sample_counter = 0
             self.net_trainer.start_round(self.start_counter)
             itr = self.itr_train
@@ -528,16 +628,19 @@ class LearnTask:
                     sample_counter += 1
                     if (sample_counter % self.print_step == 0
                             and not self.silent):
-                        elapsed = int(time.time() - start)
-                        print(f"round {self.start_counter - 1:8d}:"
-                              f"[{sample_counter:8d}] {elapsed} sec "
-                              "elapsed")
+                        elapsed = int(time.monotonic() - start)
+                        telemetry.stdout(
+                            f"round {self.start_counter - 1:8d}:"
+                            f"[{sample_counter:8d}] {elapsed} sec "
+                            "elapsed")
             finally:
                 if prefetched:
                     # an update() error mid-round must not leak the
                     # worker + its staged device batches
                     itr.close()
             self.net_trainer.finish_round_profile()
+            stats = self.net_trainer.round_stats()
+            round_label = self.start_counter
             if self.test_on_server:
                 # CheckWeight_ analog (async_updater-inl.hpp:144-153):
                 # every round, verify that replicated weights really are
@@ -548,20 +651,34 @@ class LearnTask:
                         "test_on_server: weight consistency check "
                         "failed:\n" + "\n".join(bad))
             if self.test_io == 0:
-                sys.stderr.write(f"[{self.start_counter}]")
+                line = f"[{self.start_counter}]"
                 if self.eval_train:
-                    sys.stderr.write(
-                        self.net_trainer.eval_train_metric())
+                    line += self.net_trainer.eval_train_metric()
                 for it, name in zip(self.itr_evals, self.eval_names):
-                    sys.stderr.write(self.net_trainer.evaluate(it, name))
-                sys.stderr.write("\n")
+                    line += self.net_trainer.evaluate(it, name)
+                # one write, same bytes as the historic piecewise
+                # writes; the mirrored event carries the parsed values
+                telemetry.stderr(line + "\n", event_kind="eval",
+                                 round=self.start_counter,
+                                 values=_eval_values(line))
                 sys.stderr.flush()
             self._save_model()
+            if stats is not None:
+                # per-round throughput/latency record: one `round`
+                # event on the log stream and one registry snapshot on
+                # the metrics stream (what tools/metrics_report.py
+                # tabulates). Emitted AFTER _save_model so the round's
+                # own checkpoint save cost lands in its row, not the
+                # next round's (_save_model already bumped
+                # start_counter - round_label pins the finished round).
+                telemetry.event("round", round=round_label, **stats)
+                telemetry.emit_metrics(kind="round", round=round_label,
+                                       **stats)
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a predict iterator to generate predictions"
-        print("start predicting...")
+        telemetry.stdout("start predicting...")
         # tmp + os.replace: a crash mid-run cannot leave a truncated
         # prediction file behind (same protocol as checkpoint saves)
         with atomic_writer(self.name_pred, "w") as fo:
@@ -571,7 +688,8 @@ class LearnTask:
                 pred = self.net_trainer.predict(batch)
                 for v in pred:
                     fo.write(f"{v:g}\n")
-        print(f"finished prediction, write into {self.name_pred}")
+        telemetry.stdout(
+            f"finished prediction, write into {self.name_pred}")
 
     def task_predict_raw(self) -> None:
         """task=pred_raw: one line of raw top-node outputs (e.g. the
@@ -582,7 +700,7 @@ class LearnTask:
         that conf intended."""
         assert self.itr_pred is not None, \
             "must specify a predict iterator to generate predictions"
-        print("start predicting...")
+        telemetry.stdout("start predicting...")
         with atomic_writer(self.name_pred, "w") as fo:
             self.itr_pred.before_first()
             while self.itr_pred.next():
@@ -592,14 +710,15 @@ class LearnTask:
                 flat = self.net_trainer.predict_dist(batch)
                 for row in flat:
                     fo.write(" ".join(f"{v:g}" for v in row) + "\n")
-        print(f"finished prediction, write into {self.name_pred}")
+        telemetry.stdout(
+            f"finished prediction, write into {self.name_pred}")
 
     def task_extract_feature(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a predict iterator to generate predictions"
         assert self.extract_node_name, \
             "extract node name must be specified in task extract"
-        print("start predicting...")
+        telemetry.stdout("start predicting...")
         nrow = 0
         dshape = None
         mode = "w" if self.output_format else "wb"
@@ -626,7 +745,8 @@ class LearnTask:
                     "(empty list file or dataset smaller than one batch)")
         with atomic_writer(self.name_pred + ".meta", "w") as fm:
             fm.write(f"{nrow},{dshape[0]},{dshape[1]},{dshape[2]}\n")
-        print(f"finished prediction, write into {self.name_pred}")
+        telemetry.stdout(
+            f"finished prediction, write into {self.name_pred}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

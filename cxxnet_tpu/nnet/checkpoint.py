@@ -25,11 +25,13 @@ from __future__ import annotations
 import json
 import os
 import struct
+import time
 import zlib
 from typing import Any, BinaryIO, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.utils import fault
 
 MAGIC = b"CXTPU001"
@@ -110,6 +112,7 @@ def _unflatten(items: Dict[str, np.ndarray], sep: str) -> Dict[str, Any]:
 
 def save_model(fo: BinaryIO, net_type: int, net_structure: dict, epoch: int,
                params: dict, opt_state: Optional[dict] = None) -> None:
+    t0 = time.perf_counter()
     sep = _pick_sep(params, opt_state)
     flat_params = _flatten(params, sep)
     flat_opt = _flatten(opt_state, sep) if opt_state is not None else []
@@ -152,6 +155,10 @@ def save_model(fo: BinaryIO, net_type: int, net_structure: dict, epoch: int,
     fo.write(TRAILER_MAGIC)
     fo.write(struct.pack("<Q", cw.nbytes))
     fo.write(struct.pack("<I", cw.crc))
+    # serialization-only accounting (the fsync/replace cost of the
+    # atomic protocol is timed by the task layer's checkpoint.save)
+    telemetry.observe("checkpoint.write_s", time.perf_counter() - t0)
+    telemetry.inc("checkpoint.bytes_written", cw.nbytes + TRAILER_LEN)
 
 
 def _read_exact(fi: BinaryIO, n: int, what: str) -> bytes:
@@ -168,6 +175,7 @@ def load_model(fi: BinaryIO) -> dict:
 
     Validates the crc32 trailer when present; raises ValueError on any
     truncation / corruption instead of returning garbage weights."""
+    t0 = time.perf_counter()
     cr = _CrcReader(fi)
     magic = cr.read(len(MAGIC))
     if magic != MAGIC:
@@ -202,6 +210,8 @@ def load_model(fi: BinaryIO) -> dict:
     opt_state = (_unflatten(read_arrays(header["opt_state"]), sep)
                  if header["opt_state"] else None)
     _check_trailer(fi, cr)
+    telemetry.observe("checkpoint.read_s", time.perf_counter() - t0)
+    telemetry.inc("checkpoint.bytes_read", cr.nbytes)
     return {
         "net_type": header["net_type"],
         "net": header["net"],

@@ -23,7 +23,6 @@ forward-only executable.
 from __future__ import annotations
 
 import os
-import sys
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -33,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.data import DataBatch
 from cxxnet_tpu.nnet import checkpoint
 from cxxnet_tpu.nnet.net_config import NetConfig
@@ -129,8 +129,19 @@ class NetTrainer:
         self.model_format = "native"
         self.profile = 0
         self.profile_dir = ""
+        self.trace_round = 1
         self._epoch_base = 0
         self.profiler = None
+        # telemetry_steps=0 opts OUT of per-step instrumentation while
+        # keeping event logging: per-step timing costs a device sync +
+        # loss readback per update (honest step times), which kills the
+        # async-dispatch overlap - event-only production runs can keep
+        # checkpoint/fault telemetry without paying it
+        self.telemetry_steps = 1
+        # per-step telemetry armed? captured at _build_net so the
+        # per-step branch is one attribute check (and consistent with
+        # what the compiled run actually instruments)
+        self._tel_steps = False
         if dev:
             self.set_param("dev", dev)
         if cfg:
@@ -199,6 +210,12 @@ class NetTrainer:
         if name == "profile_dir":
             self.profile_dir = val
             self.profile = max(self.profile, 1)
+        if name == "trace_round":
+            # which profiled round profile_dir traces (1-based; round 1
+            # is compile-dominated, steady state wants >= 2)
+            self.trace_round = int(val)
+        if name == "telemetry_steps":
+            self.telemetry_steps = int(val)
         if name == "dtype":
             self.compute_dtype = {"float32": jnp.float32,
                                   "bfloat16": jnp.bfloat16}[val]
@@ -258,8 +275,9 @@ class NetTrainer:
         self.net = Network(self.net_cfg, self.batch_size)
         if not self.silent:
             for i, s in enumerate(self.net.node_shapes):
-                print(f"node[{self.net_cfg.node_names[i]}].shape: "
-                      f"{s[0]},{s[1]},{s[2]},{s[3]}")
+                telemetry.stdout(
+                    f"node[{self.net_cfg.node_names[i]}].shape: "
+                    f"{s[0]},{s[1]},{s[2]},{s[3]}")
         self.mesh = build_mesh(self.mesh_spec, self.batch_size)
         self._local_rows = self._compute_local_rows()
         # tensor-parallel parameter shardings over the 'model' mesh axis
@@ -268,9 +286,15 @@ class NetTrainer:
         self._resolve_eval_nodes()
         self._build_updaters()
         self._compile()
-        if self.profile and self.profiler is None:
+        # telemetry reuses the profiler's per-round accumulator for its
+        # round records even when profile=0 (summaries print only under
+        # profile=1, so the profile-less stderr stays untouched)
+        self._tel_steps = (bool(self.telemetry_steps)
+                           and telemetry.get().enabled)
+        if (self.profile or self._tel_steps) and self.profiler is None:
             from cxxnet_tpu.utils.profiler import StepProfiler
-            self.profiler = StepProfiler(self.profile_dir)
+            self.profiler = StepProfiler(self.profile_dir,
+                                         self.trace_round)
 
     def _resolve_eval_nodes(self) -> None:
         resolved = []
@@ -673,9 +697,11 @@ class NetTrainer:
         self.round = round_counter
         if self.profiler is not None:
             # close out + report the previous round's profile, then arm
-            # the next (the first profiled round also dumps the trace)
-            if self.profiler.step_s:
-                sys.stderr.write(self.profiler.summary() + "\n")
+            # the next (the trace_round-th profiled round also dumps
+            # the trace). The stderr summary stays profile=1-only; a
+            # telemetry-only profiler feeds round records silently.
+            if self.profile and self.profiler.step_s:
+                telemetry.stderr(self.profiler.summary() + "\n")
             self.profiler.round_end()
             self.profiler.round_start()
 
@@ -689,13 +715,23 @@ class NetTrainer:
 
     def profile_summary(self) -> str:
         """Summary line for the round in progress ('' when profiling is
-        off or no steps ran); closes any open trace either way."""
+        off or no steps ran); closes any open trace either way. A
+        telemetry-only profiler (profile=0) reports nothing here - the
+        stderr surface under profile=0 is pinned byte-identical."""
         if self.profiler is None:
             return ""
         self.profiler.round_end()
-        if not self.profiler.step_s:
+        if not self.profile or not self.profiler.step_s:
             return ""
         return self.profiler.summary()
+
+    def round_stats(self) -> Optional[Dict[str, float]]:
+        """Step/data timing stats of the round in progress (None when
+        nothing is instrumented or no steps ran) - the payload of the
+        telemetry `round` event/metrics record (main.py emits them)."""
+        if self.profiler is None:
+            return None
+        return self.profiler.stats()
 
     def _compute_local_rows(self) -> Tuple[int, int]:
         """(rows this process feeds, their global start row) under the
@@ -829,7 +865,8 @@ class NetTrainer:
         Accepts a DataBatch (streamed: per-step pad/cast/H2D) or a
         StagedBatch (device-resident: zero per-step host work)."""
         import time as _time
-        t0 = _time.perf_counter() if self.profile else 0.0
+        track = bool(self.profile) or self._tel_steps
+        t0 = _time.perf_counter() if track else 0.0
         if not isinstance(batch, StagedBatch):
             # the streamed path IS one stage_batch call - structural
             # guarantee of the staged/streamed trajectory equivalence.
@@ -843,12 +880,14 @@ class NetTrainer:
         gdata, gextras = batch.data, batch.extras
         glabels, gmask = batch.labels, batch.mask
         n_examples = batch.n_examples
-        if self.profile:
+        data_s = 0.0
+        if track:
             # host-side prep (padding, casting, H2D staging) vs device
             # step, reported separately by StepProfiler.summary
             t1 = _time.perf_counter()
+            data_s = t1 - t0
             if self.profiler is not None:
-                self.profiler.add_data(t1 - t0)
+                self.profiler.add_data(data_s)
             t0 = t1
         # the step is dispatched asynchronously and train metrics
         # accumulate on device - nothing here blocks on the result, so
@@ -869,13 +908,30 @@ class NetTrainer:
         self.epoch = self._epoch_base + (
             (self._step_counter - self._skipped_steps)
             // self.update_period)
-        if self.profile:
+        if track:
+            # per-step timing forces a device sync (same cost profile=1
+            # always paid; staging prefetch still overlaps on its
+            # worker thread) - the price of honest step times
             jax.block_until_ready(self.state["epoch"])
+            step_s = _time.perf_counter() - t0
             if self.profiler is not None:
                 # distinct-instance count: wrap/pad rows in
                 # num_batch_padd would inflate images/sec
-                self.profiler.add_step(
-                    _time.perf_counter() - t0, n_examples)
+                self.profiler.add_step(step_s, n_examples)
+            if self._tel_steps:
+                tel = telemetry.get()
+                step_idx = self._step_counter - 1
+                loss_val = float(np.asarray(
+                    distributed.fetch_local(loss)))
+                tel.observe("train.data_s", data_s)
+                tel.observe("train.step_s", step_s)
+                tel.inc("train.images", n_examples)
+                tel.set_gauge("train.loss", loss_val)
+                tel.event("span", name="train.data", secs=data_s,
+                          round=self.round, step=step_idx)
+                tel.event("span", name="train.step", secs=step_s,
+                          round=self.round, step=step_idx,
+                          loss=loss_val, examples=n_examples)
 
     def _guard_step(self, finite) -> None:
         """Host half of the divergence guard: count dropped steps and
@@ -888,11 +944,15 @@ class NetTrainer:
         self._bad_consec += 1
         self.bad_rounds += 1
         self._skipped_steps += 1
-        sys.stderr.write(
+        telemetry.inc("fault.nan_rollback")
+        telemetry.stderr(
             f"divergence guard: non-finite loss/params at update "
             f"{self._step_counter - 1}; batch dropped, params rolled "
             f"back ({self._bad_consec}/{self.max_bad_rounds} "
-            f"consecutive)\n")
+            f"consecutive)\n",
+            event_kind="fault", type="nan_rollback",
+            step=self._step_counter - 1, consecutive=self._bad_consec,
+            max_bad_rounds=self.max_bad_rounds)
         if self._bad_consec >= self.max_bad_rounds:
             raise DivergenceError(
                 f"training diverged: {self._bad_consec} consecutive "
@@ -1137,7 +1197,7 @@ class NetTrainer:
                         params[lk][pn] = arr
                 copied.append(lk)
         if not self.silent:
-            print(f"finetune: copied layers {copied}")
+            telemetry.stdout(f"finetune: copied layers {copied}")
         self._init_state(jax.tree.map(jnp.asarray, params))
 
     # ------------------------------------------------------------------

@@ -1,0 +1,366 @@
+"""Telemetry: structured event log, metrics registry, span timers.
+
+The central observability layer the reference lacks (its only signal
+is a wall-clock round print, cxxnet_main.cpp:376-387). Three pieces:
+
+- a process-wide **metrics registry** (`counter` / `gauge` /
+  `histogram` with p50/p99). Rare-event counts (fault/retry/rollback,
+  checkpoint) accumulate regardless of sinks and are always queryable
+  in-process; per-step/per-batch instruments (train.*, io.prefetch.*)
+  are recorded only while a sink is armed - their timing costs a
+  device sync the disabled path must not pay;
+- **span timers**: ``with span("train.step"): ...`` observes the
+  duration into a histogram of the same name and, when an event sink
+  is configured, emits a ``span`` event. Spans nest - the recorded
+  name is the "/"-joined path of the enclosing spans on this thread.
+  With no sink configured ``span()`` returns a shared no-op context,
+  so the disabled path costs one attribute check;
+- a **central logger** with JSONL event/metric sinks (``log_file=`` /
+  ``metrics_file=`` config keys, ``log_format=json|text``, periodic
+  ``heartbeat_secs=`` snapshots). ``stdout()`` / ``stderr()`` write
+  the EXACT text the pre-telemetry code printed - byte-for-byte stderr
+  parity when no sink is configured is a hard contract (tests pin it)
+  - while mirroring a structured event when a sink is armed.
+
+Every record carries {ts, host, pid, proc, device} tags so
+multi-process runs produce mergeable streams. Config plumbing lives in
+main.py; the full schema is docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from cxxnet_tpu.telemetry.registry import (
+    Counter, Gauge, Histogram, MetricsRegistry)
+from cxxnet_tpu.telemetry.sink import LineSink, read_jsonl
+
+__all__ = [
+    "Telemetry", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "LineSink", "read_jsonl", "get", "configure", "close", "enabled",
+    "metrics_enabled", "counter", "gauge", "histogram", "inc",
+    "set_gauge", "observe", "span", "event", "emit_metrics", "stdout",
+    "stderr", "set_tags", "reset_for_tests",
+]
+
+
+class _NullSpan:
+    """Reusable no-op context manager: the disabled span path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Timed span: pushes its name on the thread's span stack so
+    nested spans record "outer/inner" paths."""
+
+    __slots__ = ("_tel", "_name", "_fields", "_path", "_t0")
+
+    def __init__(self, tel: "Telemetry", name: str, fields: Dict):
+        self._tel = tel
+        self._name = name
+        self._fields = fields
+        self._path = name
+        self._t0 = 0.0
+
+    def __enter__(self):
+        stack = self._tel._span_stack()
+        self._path = ("/".join(stack) + "/" + self._name if stack
+                      else self._name)
+        stack.append(self._name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        secs = time.perf_counter() - self._t0
+        stack = self._tel._span_stack()
+        if stack:
+            stack.pop()
+        self._tel.observe(self._path, secs)
+        self._tel.event("span", name=self._path, secs=secs,
+                        **self._fields)
+        return False
+
+
+class Telemetry:
+    """One logger + registry + sinks bundle. A process normally uses
+    the module-level singleton (`telemetry.get()`); separate instances
+    exist for tests."""
+
+    def __init__(self) -> None:
+        self.registry = MetricsRegistry()
+        self._log: Optional[LineSink] = None
+        self._metrics: Optional[LineSink] = None
+        self.heartbeat_secs = 0.0
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._local = threading.local()
+        self._tags: Dict[str, object] = {
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "proc": 0,
+        }
+
+    # -- configuration -----------------------------------------------------
+    def configure(self, log_file: str = "", metrics_file: str = "",
+                  log_format: str = "json", heartbeat_secs: float = 0.0,
+                  tags: Optional[Dict[str, object]] = None) -> None:
+        """(Re)arm the sinks. Idempotent and terminal for the previous
+        configuration: earlier sinks are flushed and closed first, so a
+        CLI process that runs several tasks back-to-back (the test
+        suite does) never leaks file handles or cross-writes streams.
+        Empty paths disarm - configure() with no arguments returns the
+        process to the zero-overhead disabled state."""
+        self._stop_heartbeat()
+        if self._log is not None:
+            self._log.close()
+        if self._metrics is not None:
+            self._metrics.close()
+        self._log = LineSink(log_file, log_format) if log_file else None
+        self._metrics = (LineSink(metrics_file, "json")
+                         if metrics_file else None)
+        if tags:
+            self._tags.update(tags)
+        self.heartbeat_secs = float(heartbeat_secs or 0.0)
+        if self.heartbeat_secs > 0 and (self._log or self._metrics):
+            self._start_heartbeat()
+
+    def set_tags(self, **tags) -> None:
+        """Late tag refinement (e.g. `proc` once jax.process_index()
+        is known after distributed init)."""
+        self._tags.update(tags)
+
+    def close(self) -> None:
+        """Flush + close sinks and stop the heartbeat; the registry
+        keeps accumulating (counters outlive any one sink's life)."""
+        self._stop_heartbeat()
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+        if self._metrics is not None:
+            self._metrics.close()
+            self._metrics = None
+
+    @property
+    def enabled(self) -> bool:
+        """True when ANY sink is armed (events or metrics stream)."""
+        return self._log is not None or self._metrics is not None
+
+    @property
+    def metrics_enabled(self) -> bool:
+        return self._metrics is not None
+
+    # -- registry sugar ----------------------------------------------------
+    def counter(self, name: str) -> Counter:
+        return self.registry.counter(name)
+
+    def gauge(self, name: str) -> Gauge:
+        return self.registry.gauge(name)
+
+    def histogram(self, name: str) -> Histogram:
+        return self.registry.histogram(name)
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self.registry.counter(name).inc(n)
+
+    def set_gauge(self, name: str, v: float) -> None:
+        self.registry.gauge(name).set(v)
+
+    def observe(self, name: str, v: float) -> None:
+        self.registry.histogram(name).observe(v)
+
+    # -- spans -------------------------------------------------------------
+    def _span_stack(self):
+        stack = getattr(self._local, "spans", None)
+        if stack is None:
+            stack = self._local.spans = []
+        return stack
+
+    def span(self, name: str, **fields):
+        """Timed context manager; no-op singleton when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, fields)
+
+    # -- events ------------------------------------------------------------
+    def _record(self, kind: str, fields: Dict) -> Dict[str, object]:
+        rec: Dict[str, object] = {"ts": time.time(), "kind": kind}
+        rec.update(self._tags)
+        rec.update(fields)
+        return rec
+
+    def event(self, kind: str, **fields) -> None:
+        """Emit a structured event to the event log (no-op unarmed)."""
+        log = self._log
+        if log is not None:
+            log.write(self._record(kind, fields))
+
+    def emit_metrics(self, kind: str = "metrics", **fields) -> None:
+        """Emit a full registry snapshot record to the metrics stream
+        (no-op when metrics_file is unarmed). Extra fields ride on the
+        record - per-round emitters attach round/step/throughput."""
+        sink = self._metrics
+        if sink is not None:
+            fields = dict(fields)
+            fields["metrics"] = self.registry.snapshot()
+            sink.write(self._record(kind, fields))
+
+    def flush(self) -> None:
+        if self._log is not None:
+            self._log.flush()
+        if self._metrics is not None:
+            self._metrics.flush()
+
+    # -- the central logger ------------------------------------------------
+    def stdout(self, text: str) -> None:
+        """Exactly `print(text)` - THE sanctioned stdout path for
+        cxxnet_tpu outside tools/ (CI lints bare print() away). When an
+        event sink is armed the line is mirrored as a `log` event."""
+        print(text)  # noqa: T201 - the one sanctioned print
+        log = self._log
+        if log is not None:
+            log.write(self._record("log", {"stream": "stdout",
+                                           "text": text}))
+
+    def stderr(self, text: str, event_kind: str = "", **fields) -> None:
+        """Write `text` to sys.stderr byte-for-byte (stderr parity with
+        the pre-telemetry CLI is a pinned contract), mirroring a
+        structured event when a sink is armed: `event_kind` + fields if
+        given, else a plain `log` record."""
+        sys.stderr.write(text)
+        log = self._log
+        if log is not None:
+            if event_kind:
+                log.write(self._record(event_kind, fields))
+            else:
+                log.write(self._record("log", {"stream": "stderr",
+                                               "text": text}))
+
+    # -- heartbeat ---------------------------------------------------------
+    def _start_heartbeat(self) -> None:
+        # the thread binds ITS stop event + interval at spawn: a thread
+        # that outlives _stop_heartbeat's bounded join (blocked on a
+        # slow disk) must see its own, already-set event when it wakes
+        # - re-reading self._hb_stop would pick up the NEXT config's
+        # fresh event and loop forever as a duplicate-emitting zombie
+        stop = self._hb_stop = threading.Event()
+        interval = self.heartbeat_secs
+
+        def run():
+            while not stop.wait(interval):
+                with contextlib.suppress(Exception):
+                    # a dying heartbeat must never take training down
+                    self.emit_metrics(kind="heartbeat")
+                    self.event("heartbeat")
+                    self.flush()
+
+        self._hb_thread = threading.Thread(
+            target=run, name="telemetry-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def _stop_heartbeat(self) -> None:
+        if self._hb_thread is None:
+            return
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=2.0)
+        self._hb_thread = None
+
+
+# ---------------------------------------------------------------------------
+# process-wide singleton + module-level convenience API (the registry is
+# process state, like utils/fault's registry)
+# ---------------------------------------------------------------------------
+_TEL = Telemetry()
+
+
+def get() -> Telemetry:
+    return _TEL
+
+
+def configure(**kwargs) -> None:
+    _TEL.configure(**kwargs)
+
+
+def close() -> None:
+    _TEL.close()
+
+
+def enabled() -> bool:
+    return _TEL.enabled
+
+
+def metrics_enabled() -> bool:
+    return _TEL.metrics_enabled
+
+
+def counter(name: str) -> Counter:
+    return _TEL.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _TEL.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _TEL.histogram(name)
+
+
+def inc(name: str, n: int = 1) -> None:
+    _TEL.inc(name, n)
+
+
+def set_gauge(name: str, v: float) -> None:
+    _TEL.set_gauge(name, v)
+
+
+def observe(name: str, v: float) -> None:
+    _TEL.observe(name, v)
+
+
+def span(name: str, **fields):
+    return _TEL.span(name, **fields)
+
+
+def event(kind: str, **fields) -> None:
+    _TEL.event(kind, **fields)
+
+
+def emit_metrics(kind: str = "metrics", **fields) -> None:
+    _TEL.emit_metrics(kind, **fields)
+
+
+def stdout(text: str) -> None:
+    _TEL.stdout(text)
+
+
+def stderr(text: str, event_kind: str = "", **fields) -> None:
+    _TEL.stderr(text, event_kind, **fields)
+
+
+def set_tags(**tags) -> None:
+    _TEL.set_tags(**tags)
+
+
+def reset_for_tests() -> None:
+    """Close sinks, wipe the registry, and restore default tags -
+    test isolation only (configure()/set_tags mutate the process-wide
+    tag dict, which must not leak across tests)."""
+    _TEL.close()
+    _TEL.registry.reset()
+    _TEL._tags = {"host": socket.gethostname(), "pid": os.getpid(),
+                  "proc": 0}

@@ -47,6 +47,23 @@ class DivergenceError(RuntimeError):
     update rounds (nnet/trainer.py divergence guard)."""
 
 
+def default_on_retry(fn, attempt, total, exc, sleep_s):
+    """Per-retry notification: the exact pre-telemetry stderr text,
+    routed through the central logger (a structured ``fault`` event
+    when a sink is armed) plus a ``fault.retry`` counter, so retry
+    storms are countable instead of vanishing into stderr."""
+    from cxxnet_tpu import telemetry
+    telemetry.inc("fault.retry")
+    telemetry.stderr(
+        f"retry: {getattr(fn, '__qualname__', fn)} failed "
+        f"(attempt {attempt}/{total}: {type(exc).__name__}: {exc}); "
+        f"retrying in {sleep_s:.2f}s\n",
+        event_kind="fault", type="retry",
+        fn=str(getattr(fn, "__qualname__", fn)), attempt=attempt,
+        attempts=total, error=f"{type(exc).__name__}: {exc}",
+        sleep_s=sleep_s)
+
+
 # ---------------------------------------------------------------------------
 # retry
 # ---------------------------------------------------------------------------
@@ -70,12 +87,6 @@ def retry(attempts: int = 3, backoff: float = 0.05, jitter: float = 0.05,
     """
     if attempts < 1:
         raise ValueError("retry: attempts must be >= 1")
-
-    def default_on_retry(fn, attempt, total, exc, sleep_s):
-        sys.stderr.write(
-            f"retry: {getattr(fn, '__qualname__', fn)} failed "
-            f"(attempt {attempt}/{total}: {type(exc).__name__}: {exc}); "
-            f"retrying in {sleep_s:.2f}s\n")
 
     notify = on_retry or default_on_retry
 

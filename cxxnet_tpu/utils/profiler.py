@@ -6,13 +6,19 @@ Two levels:
 - `profile = 1`: per-round summaries of device step time vs host data
   time (p50/p99/images-per-sec), printed to stderr next to the metrics.
 - `profile_dir = <path>`: additionally dumps an XLA/TensorBoard trace
-  via jax.profiler for the first profiled round (op-level timeline on
-  TPU; view with tensorboard or xprof).
+  via jax.profiler for ONE profiled round (op-level timeline on TPU;
+  view with tensorboard or xprof). `trace_round = N` selects WHICH
+  profiled round is traced (1-based, default 1: the first) - round 1
+  is dominated by XLA compilation, so steady-state traces want N >= 2.
+
+The telemetry subsystem (cxxnet_tpu/telemetry) reuses this accumulator
+for its per-round stats records even when profile=0; see
+NetTrainer.round_stats and docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -20,8 +26,12 @@ import numpy as np
 class StepProfiler:
     """Accumulates step + data timings for one round at a time."""
 
-    def __init__(self, trace_dir: str = ""):
+    def __init__(self, trace_dir: str = "", trace_round: int = 1):
         self.trace_dir = trace_dir
+        # which profiled round gets the jax.profiler trace (1-based
+        # count of round_start calls); exactly one round is ever traced
+        self.trace_round = max(1, int(trace_round))
+        self._round_idx = 0
         self._tracing = False
         self._traced_once = False
         self.reset()
@@ -34,7 +44,9 @@ class StepProfiler:
     # -- hooks -------------------------------------------------------------
     def round_start(self) -> None:
         self.reset()
-        if self.trace_dir and not self._traced_once:
+        self._round_idx += 1
+        if (self.trace_dir and not self._traced_once
+                and self._round_idx == self.trace_round):
             import jax
             jax.profiler.start_trace(self.trace_dir)
             self._tracing = True
@@ -54,17 +66,36 @@ class StepProfiler:
         self.data_s.append(seconds)
 
     # -- reporting ---------------------------------------------------------
-    def summary(self) -> str:
+    def stats(self) -> Optional[Dict[str, float]]:
+        """Round stats as a JSON-ready dict (None when no steps ran).
+        Robust to an empty data_s (staged/membuffer paths can deliver
+        rounds with zero recorded host-data time) and to zero counted
+        examples (test_io rounds)."""
         if not self.step_s:
+            return None
+        s = np.asarray(self.step_s, dtype=np.float64)
+        data_total = float(sum(self.data_s))
+        total = float(s.sum()) + data_total
+        return {
+            "steps": len(self.step_s),
+            "examples": self.examples,
+            "step_p50_ms": float(np.percentile(s, 50)) * 1e3,
+            "step_p99_ms": float(np.percentile(s, 99)) * 1e3,
+            "step_total_s": float(s.sum()),
+            "data_total_ms": data_total * 1e3,
+            "images_per_sec": (self.examples / total if total > 0
+                               else float("nan")),
+        }
+
+    def summary(self) -> str:
+        st = self.stats()
+        if st is None:
             return "\tprofile: no steps"
-        s = np.asarray(self.step_s)
-        total = s.sum() + sum(self.data_s)
-        ips = self.examples / total if total > 0 else float("nan")
-        out = (f"\tprofile: {len(s)} steps, "
-               f"step p50 {np.percentile(s, 50) * 1e3:.2f} ms "
-               f"p99 {np.percentile(s, 99) * 1e3:.2f} ms, "
-               f"data {sum(self.data_s) * 1e3:.1f} ms total, "
-               f"{ips:.1f} images/sec")
+        out = (f"\tprofile: {st['steps']} steps, "
+               f"step p50 {st['step_p50_ms']:.2f} ms "
+               f"p99 {st['step_p99_ms']:.2f} ms, "
+               f"data {st['data_total_ms']:.1f} ms total, "
+               f"{st['images_per_sec']:.1f} images/sec")
         if self.trace_dir:
             out += f", trace -> {self.trace_dir}"
         return out

@@ -163,7 +163,7 @@ def train(cfg: str, data, label, num_round: int,
     stderr like the CLI round loop - regression nets should evaluate
     manually. The final partial batch of each round trains too (padded
     internally)."""
-    import sys as _sys
+    from cxxnet_tpu import telemetry
     net = Net(dev=dev, cfg=cfg)
     net.set_param("batch_size", batch_size)
     for k, v in (param.items() if isinstance(param, dict) else param):
@@ -225,7 +225,9 @@ def train(cfg: str, data, label, num_round: int,
                          for i in range(0, ed.shape[0], batch_size)]
                 pred = np.concatenate(preds)
                 err = float((pred != np.asarray(el).reshape(-1)).mean())
-                _sys.stderr.write(f"[{r}]\teval-error:{err:g}\n")
+                telemetry.stderr(f"[{r}]\teval-error:{err:g}\n",
+                                 event_kind="eval", round=r,
+                                 values={"eval-error": err})
     finally:
         if pf is not None:
             pf.close()  # a mid-round error must not leak the worker
