@@ -855,6 +855,47 @@ def _bench_prefetch(ctx) -> dict:
         return {"e2e_prefetch_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_fused(ctx) -> dict:
+    """e2e with fused multi-step dispatch (steps_per_dispatch=K,
+    docs/PERFORMANCE.md): K host batches stage + stack into one
+    StagedChunk and ONE jitted scan runs all K updates, so the host
+    pays one dispatch + zero per-step readbacks per K steps. The
+    derived `fused_over_e2e` ratio vs `e2e_ips` prices exactly the
+    per-step dispatch overhead this removes (CPU harness ratios are
+    meaningful - both sides pace the same host; the TPU field names
+    are wired for the next verified-sync run). One extra compile (the
+    chunk executable inlines K step bodies). K via CXN_BENCH_FUSED_K,
+    default 4. Disable with CXN_BENCH_FUSED=0."""
+    if os.environ.get("CXN_BENCH_FUSED") == "0":
+        return {}
+    try:
+        from cxxnet_tpu.io.data import DataBatch
+        tr = ctx.trainer
+        batch = ctx.batch
+        k = max(2, int(os.environ.get("CXN_BENCH_FUSED_K", "4")))
+        rng = np.random.RandomState(13)
+        nbuf = 8
+        batches = [DataBatch(*_alexnet_batch(rng, batch))
+                   for _ in range(nbuf)]
+
+        def chunk_at(i):
+            return [batches[(i * k + j) % nbuf] for j in range(k)]
+
+        nchunks = _warm_and_size(
+            tr, lambda i: tr.update_chunk(chunk_at(i)),
+            max(2, ctx.steps // k), 45.0, floor=2)
+        t0 = time.perf_counter()
+        for i in range(nchunks):
+            tr.update_chunk(chunk_at(i))
+        _sync(tr.state)
+        dt = time.perf_counter() - t0
+        return {"e2e_fused_ips": round(nchunks * k * batch / dt, 2),
+                "e2e_fused_k": k,
+                "e2e_fused_steps": nchunks * k}
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"e2e_fused_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_pool_ties(make, batch, steps, platform: str) -> dict:
     """Compute-path throughput with `pool_grad = ties` (the reference's
     tie-duplicating max-pool backward) vs the bench flagship's
@@ -1025,6 +1066,7 @@ _MEASUREMENTS = (
     ("device_data", _bench_device_data, "CXN_BENCH_DEVDATA", 100,
      "compute"),
     ("e2e_prefetch", _bench_prefetch, "CXN_BENCH_PREFETCH", 150, "h2d"),
+    ("fused", _bench_fused, "CXN_BENCH_FUSED", 150, "h2d"),
     ("attention",
      lambda c: _bench_attention(c.platform), "CXN_BENCH_ATTN", 100,
      "compute"),
@@ -1062,6 +1104,7 @@ _GFLOP_PER_IMG = {
     "e2e_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_devicedata_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_prefetch_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
+    "e2e_fused_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_f32stage_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "device_augment_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_eval_train_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
@@ -1119,6 +1162,14 @@ def _derive(out: dict, batch: int, platform: str, ndev: int,
         # a physics check may have retracted a source a previous merge
         # derived from; stale ratios must not outlive their inputs
         out.pop("e2e_over_compute", None)
+    fused = out.get("e2e_fused_ips")
+    if fused and e2e:
+        # the K>1 vs K=1 ratio: what fusing K steps into one dispatch
+        # buys over the per-step e2e path (>1 = dispatch overhead was
+        # a real cost in this window)
+        out["fused_over_e2e"] = round(fused / e2e, 4)
+    else:
+        out.pop("fused_over_e2e", None)
     if e2e:
         out["metric"] = "alexnet_b%d_%s_train_e2e" % (batch, platform)
         out["value"], out["value_is"] = e2e, "e2e"
@@ -1250,6 +1301,7 @@ _LAST_GOOD_PATH = os.path.join(_REPO, "docs", "last_good_tpu.json")
 # make them interpretable
 _LAST_GOOD_MAX_FIELDS = (
     "compute_ips", "e2e_ips", "e2e_devicedata_ips", "e2e_prefetch_ips",
+    "e2e_fused_ips",
     "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
     "resnet18_ips", "resnet18_devicedata_ips",
     "device_augment_ips", "chip_matmul_tflops", "attn_pallas_tflops",
@@ -1331,6 +1383,7 @@ _SYNC_SOURCE = {
     "compute_ips": "compute", "e2e_ips": "e2e",
     "e2e_devicedata_ips": "device_data",
     "e2e_prefetch_ips": "e2e_prefetch",
+    "e2e_fused_ips": "fused",
     "compute_poolties_ips": "pool_ties", "googlenet_ips": "googlenet",
     "googlenet_devicedata_ips": "googlenet",
     "resnet18_ips": "resnet18", "resnet18_devicedata_ips": "resnet18",

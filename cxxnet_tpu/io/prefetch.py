@@ -38,12 +38,23 @@ class StagedPrefetcher:
     DataBatches. Up to depth+1 staged batches are resident at once
     (depth queued plus the one the worker holds while the queue is
     full), each pinning its device buffers in HBM until consumed -
-    budget HBM headroom for depth+1, not depth."""
+    budget HBM headroom for depth+1, not depth.
 
-    def __init__(self, stage_fn, source, depth: int = 1):
+    Fused dispatch (steps_per_dispatch=K, docs/PERFORMANCE.md):
+    chunk=K makes the worker assemble K staged batches into one
+    StagedChunk via chunk_fn (trainer.stage_chunk) per queue item -
+    the last item of a pass may be a SHORT chunk (the round-boundary
+    flush). HBM budget then scales to K*(depth+1) batches resident."""
+
+    def __init__(self, stage_fn, source, depth: int = 1,
+                 chunk: int = 1, chunk_fn=None):
         self.stage_fn = stage_fn
         self.source = source
         self.depth = max(1, int(depth))
+        self.chunk = max(1, int(chunk))
+        if self.chunk > 1 and chunk_fn is None:
+            raise ValueError("chunk > 1 requires chunk_fn")
+        self.chunk_fn = chunk_fn
         self._q = None
         self._thread = None
         self._stop = threading.Event()
@@ -87,28 +98,45 @@ class StagedPrefetcher:
             return False
         t0 = time.perf_counter() if self._tel else 0.0
         stalled = False
-        while True:
-            try:
-                item = self._q.get(timeout=0.2)
-                break
-            except queue.Empty:
-                # the staging worker is behind the consumer: the train
-                # loop is data-bound right now (prefetch stall)
-                stalled = True
-                if self._thread is not None and self._thread.is_alive():
-                    continue
-                # worker died without delivering a batch, _END, or an
-                # exception (e.g. killed interpreter-side): one last
-                # race-free sweep, then fail instead of hanging forever
+        try:
+            # common path: the worker is ahead and the queue is
+            # non-empty - ONE non-blocking get, zero timeout wakeups
+            # (the old 0.2 s get-loop woke 5x/sec for the whole stall
+            # on data-bound runs)
+            item = self._q.get_nowait()
+        except queue.Empty:
+            # the staging worker is behind the consumer: block on the
+            # queue. The first get keeps the historic 0.2 s bar so the
+            # io.prefetch.stalls metric retains its meaning (a wait
+            # the consumer actually felt, not an instantaneously-empty
+            # queue); later gets stretch to 2 s - the timeout then
+            # exists ONLY as the dead-worker sweep (a healthy worker
+            # always delivers a batch, _END, or its exception)
+            timeout = 0.2
+            while True:
                 try:
-                    item = self._q.get_nowait()
+                    item = self._q.get(timeout=timeout)
                     break
                 except queue.Empty:
-                    self._exhausted = True
-                    raise RuntimeError(
-                        "staged-prefetch worker died without delivering "
-                        "a batch or an error; the data pipeline is gone "
-                        "(see stderr for the worker's traceback)")
+                    stalled = True
+                    timeout = 2.0
+                    if (self._thread is not None
+                            and self._thread.is_alive()):
+                        continue
+                    # worker died without delivering a batch, _END, or
+                    # an exception (e.g. killed interpreter-side): one
+                    # last race-free sweep, then fail instead of
+                    # hanging forever
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        self._exhausted = True
+                        raise RuntimeError(
+                            "staged-prefetch worker died without "
+                            "delivering a batch or an error; the data "
+                            "pipeline is gone (see stderr for the "
+                            "worker's traceback)")
         if item is _END:
             self._exhausted = True
             return False
@@ -173,8 +201,32 @@ class StagedPrefetcher:
 
     def _run(self) -> None:
         try:
+            pending = []
             while not self._stop.is_set() and self.source.next():
-                if not self._put(self.stage_fn(self.source.value())):
+                staged = self.stage_fn(self.source.value())
+                if self.chunk <= 1:
+                    if not self._put(staged):
+                        return
+                    continue
+                pending.append(staged)
+                if len(pending) >= self.chunk:
+                    # release the per-batch staged singles BEFORE the
+                    # (possibly long) blocking put: holding them
+                    # through a full-queue wait would pin K extra
+                    # batches of HBM beyond the documented
+                    # K*(depth+1) budget
+                    item = self.chunk_fn(pending)
+                    pending = []
+                    if not self._put(item):
+                        return
+            if pending and not self._stop.is_set():
+                # round-boundary flush: the pass ended mid-chunk; a
+                # SHORT chunk ships the tail so every delivered batch
+                # trains this round (dropping it would silently starve
+                # the trailing batches of every epoch)
+                item = self.chunk_fn(pending)
+                pending = []
+                if not self._put(item):
                     return
             self._put(_END)
         except BaseException as e:  # noqa: BLE001 - re-raised in next()

@@ -26,7 +26,7 @@ from typing import List, Optional, Tuple
 
 from cxxnet_tpu import telemetry
 from cxxnet_tpu.io import create_iterator
-from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.nnet.trainer import NetTrainer, StagedChunk
 from cxxnet_tpu.utils.config import parse_config_file
 from cxxnet_tpu.utils.fault import DivergenceError, atomic_writer
 
@@ -64,6 +64,10 @@ class LearnTask:
         # depth of the H2D staging prefetch for the train loop
         # (io/prefetch.py); 0 streams batches on the update thread
         self.prefetch_stage = 1
+        # fused multi-step dispatch: K staged batches scan through ONE
+        # jitted executable per dispatch (docs/PERFORMANCE.md); 1 =
+        # per-step dispatch, byte-for-byte today's behavior
+        self.steps_per_dispatch = 1
         self.batch_size = 0
         self.silent = 0
         self.start_counter = 0
@@ -188,6 +192,8 @@ class LearnTask:
             self.test_io = int(val)
         if name == "prefetch_stage":
             self.prefetch_stage = int(val)
+        if name == "steps_per_dispatch":
+            self.steps_per_dispatch = int(val)
         if name == "batch_size":
             self.batch_size = int(val)
         if name == "eval_train":
@@ -615,16 +621,28 @@ class LearnTask:
             self.net_trainer.start_round(self.start_counter)
             itr = self.itr_train
             prefetched = self.test_io == 0 and self.prefetch_stage > 0
+            # fused dispatch (docs/PERFORMANCE.md): K batches per
+            # jitted scan; test_io keeps per-batch accounting (it
+            # measures the pipeline, nothing dispatches)
+            fused_k = (self.steps_per_dispatch if self.test_io == 0
+                       else 1)
             if prefetched:
                 # stage batch k+1 (pad+cast+H2D) on a worker thread
-                # while step k runs (io/prefetch.py); test_io keeps the
+                # while step k runs (io/prefetch.py); chunk=K makes
+                # the worker assemble fused chunks; test_io keeps the
                 # raw iterator - it measures the pipeline, not staging
-                itr = self.net_trainer.prefetch(itr, self.prefetch_stage)
-            try:
-                itr.before_first()
-                while itr.next():
-                    if self.test_io == 0:
-                        self.net_trainer.update(itr.value())
+                itr = self.net_trainer.prefetch(
+                    itr, self.prefetch_stage, chunk=fused_k)
+            pending = []  # fused, non-prefetched: batches awaiting K
+
+            def tick(n_micro):
+                # per-TRAINED-microstep progress accounting: fused
+                # paths tick only after their chunk dispatched, so the
+                # progress line never claims samples a failed chunk
+                # would leave untrained (and K=1 keeps the historic
+                # per-batch print cadence byte-for-byte)
+                nonlocal sample_counter
+                for _ in range(n_micro):
                     sample_counter += 1
                     if (sample_counter % self.print_step == 0
                             and not self.silent):
@@ -633,6 +651,35 @@ class LearnTask:
                             f"round {self.start_counter - 1:8d}:"
                             f"[{sample_counter:8d}] {elapsed} sec "
                             "elapsed")
+
+            try:
+                itr.before_first()
+                while itr.next():
+                    v = itr.value()
+                    n_micro = 1
+                    if self.test_io == 0:
+                        if fused_k > 1 and not prefetched:
+                            pending.append(v)
+                            n_micro = 0
+                            if len(pending) >= fused_k:
+                                n_micro = len(pending)
+                                self.net_trainer.update_chunk(pending)
+                                pending = []
+                        else:
+                            # a StagedChunk (prefetched fused mode)
+                            # routes to update_chunk inside update()
+                            if isinstance(v, StagedChunk):
+                                n_micro = v.n_steps
+                            self.net_trainer.update(v)
+                    tick(n_micro)
+                if pending:
+                    # round-boundary flush: the pass ended mid-chunk -
+                    # a SHORT fused chunk trains the tail batches this
+                    # round instead of silently dropping them
+                    n_micro = len(pending)
+                    self.net_trainer.update_chunk(pending)
+                    pending = []
+                    tick(n_micro)
             finally:
                 if prefetched:
                     # an update() error mid-round must not leak the

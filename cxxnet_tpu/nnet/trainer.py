@@ -23,7 +23,8 @@ forward-only executable.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -60,6 +61,25 @@ class StagedBatch(NamedTuple):
     labels: Dict[str, Any]
     mask: Any
     n_examples: int
+
+
+class StagedChunk(NamedTuple):
+    """K staged batches stacked along a leading microstep axis - the
+    input of ONE fused dispatch (steps_per_dispatch=K): a single jitted
+    lax.scan carries the train state through all K updates, so the
+    host pays one dispatch + one readback per chunk instead of K
+    (docs/PERFORMANCE.md). Built by stage_chunk from the exact
+    per-batch staging pipeline, so the weight trajectory is bitwise
+    identical to K streamed updates."""
+    data: Any                      # (K, ...) under the chunked sharding
+    extras: Tuple[Any, ...]        # each (K, ...)
+    labels: Dict[str, Any]         # each (K, ...)
+    mask: Any                      # (K, batch)
+    n_examples: Tuple[int, ...]    # distinct instances per microstep
+
+    @property
+    def n_steps(self) -> int:
+        return len(self.n_examples)
 
 
 def _bf16_cast(data: np.ndarray) -> np.ndarray:
@@ -127,6 +147,14 @@ class NetTrainer:
         self._bad_consec = 0
         self._skipped_steps = 0
         self.model_format = "native"
+        # fused multi-step dispatch (docs/PERFORMANCE.md): K staged
+        # batches scan through ONE jitted executable per chunk. 1 =
+        # today's streamed/staged per-step dispatch, byte-for-byte.
+        self.steps_per_dispatch = 1
+        # eval loop in-flight bound: sync on the tiny metric rows every
+        # N batches so at most N batches of input buffers pin HBM
+        # (0 = never sync - the whole eval set may stage ahead)
+        self.eval_inflight = 8
         self.profile = 0
         self.profile_dir = ""
         self.trace_round = 1
@@ -205,6 +233,14 @@ class NetTrainer:
             if val not in ("native", "cxxnet"):
                 raise ValueError("model_format must be native or cxxnet")
             self.model_format = val
+        if name == "steps_per_dispatch":
+            if int(val) < 1:
+                raise ValueError("steps_per_dispatch must be >= 1")
+            self.steps_per_dispatch = int(val)
+        if name == "eval_inflight":
+            if int(val) < 0:
+                raise ValueError("eval_inflight must be >= 0")
+            self.eval_inflight = int(val)
         if name == "profile":
             self.profile = int(val)
         if name == "profile_dir":
@@ -679,6 +715,66 @@ class NetTrainer:
             out_shardings=((state_shardings, rep, rep) if check_nan
                            else (state_shardings, rep)),
             donate_argnums=(0,))
+
+        # fused multi-step dispatch (steps_per_dispatch=K): ONE jitted
+        # lax.scan carries state through K full train steps. The scan
+        # body IS train_step - same math, same metric folds, same
+        # in-jit guard rollback - with the per-step RNG folded ON
+        # DEVICE from the identical (seed, step_counter) stream, so
+        # the trajectory is bitwise K streamed updates. Per-microstep
+        # (loss, finite) vectors come back so the divergence guard and
+        # loss gauge keep exact per-step semantics with one host
+        # readback per chunk. Chunk length K is read from the stacked
+        # leading axis (a short final chunk just retraces).
+        def _chunked(s: NamedSharding) -> NamedSharding:
+            return NamedSharding(self.mesh, P(None, *s.spec))
+
+        cshd, cdshd = _chunked(shd), _chunked(dshd)
+        ceshd = (cshd,) * self.net_cfg.extra_data_num
+        clabel_shardings = {f: cshd for f in self.net_cfg.label_name_map}
+        self._chunk_stack_shardings = (cdshd, ceshd, clabel_shardings,
+                                       cshd)
+
+        def train_chunk(state, data, extras, labels, mask, step_idx,
+                        base_rng):
+            def body(st, xs):
+                d, ex, lb, mk, idx = xs
+                rng = jax.random.fold_in(base_rng, idx)
+                if check_nan:
+                    st, loss, finite = train_step(st, d, ex, lb, mk,
+                                                  rng)
+                else:
+                    st, loss = train_step(st, d, ex, lb, mk, rng)
+                    finite = jnp.bool_(True)
+                return st, (loss, finite)
+
+            # unroll=True: ONE flat XLA program with the K microstep
+            # bodies inlined - the whole point (hand the compiler the
+            # full dataflow region so it can schedule across step
+            # boundaries), and the condition for the bitwise guarantee:
+            # a rolled while-loop body compiled the fc backward with
+            # ~1-ULP different contractions than the standalone step
+            # (measured on jax-cpu), while the inlined bodies compile
+            # identically. Cost: compile time grows with K, and each
+            # distinct chunk length (e.g. the short round-end chunk)
+            # retraces once - keep K modest (docs/PERFORMANCE.md).
+            state, (losses, finites) = lax.scan(
+                body, state, (data, extras, labels, mask, step_idx),
+                unroll=True)
+            return state, losses, finites
+
+        self._train_chunk = jax.jit(
+            train_chunk,
+            in_shardings=(state_shardings, cdshd, ceshd,
+                          clabel_shardings, cshd, rep, rep),
+            out_shardings=(state_shardings, rep, rep),
+            donate_argnums=(0,))
+        # device-side stacker: K staged batches -> one chunk. Pure
+        # data movement after the per-batch staging pipeline, which is
+        # the structural trajectory-equality argument (stage_chunk).
+        self._stack_chunk = jax.jit(
+            lambda *bs: jax.tree.map(lambda *ls: jnp.stack(ls), *bs),
+            out_shardings=self._chunk_stack_shardings)
         self._eval_step = jax.jit(
             eval_step, in_shardings=(self._pshard, dshd, eshd),
             out_shardings=shd)
@@ -851,22 +947,49 @@ class NetTrainer:
             mask=distributed.put_global(mask.astype(np.float32), shd),
             n_examples=batch.batch_size - batch.num_batch_padd)
 
-    def prefetch(self, data_iter, depth: int = 1):
+    def stage_chunk(self, batches: Sequence) -> StagedChunk:
+        """Stack K batches into one fused-dispatch chunk (StagedChunk).
+        Each unstaged batch runs the EXACT per-batch staging pipeline
+        (stage_batch), then a jitted device-side stack prepends the
+        microstep axis - pure data movement, so a fused chunk is
+        trajectory-identical to streaming its batches one by one.
+        Accepts DataBatch and StagedBatch mixed; K is len(batches)
+        (a short final chunk at round end is fine - the scan reads
+        its length from the stacked axis)."""
+        if not batches:
+            raise ValueError("stage_chunk needs at least one batch")
+        staged = [b if isinstance(b, StagedBatch) else
+                  self.stage_batch(b) for b in batches]
+        data, extras, labels, mask = self._stack_chunk(
+            *((s.data, s.extras, s.labels, s.mask) for s in staged))
+        return StagedChunk(
+            data=data, extras=extras, labels=labels, mask=mask,
+            n_examples=tuple(s.n_examples for s in staged))
+
+    def prefetch(self, data_iter, depth: int = 1, chunk: int = 1):
         """Wrap a DataIter so batch k+1 is staged (pad + cast + H2D)
         on a worker thread while step k runs - the reference's
         ThreadBuffer idea applied at the host->device edge
         (io/prefetch.py). update() consumes the staged values with
-        zero per-step host work; trajectory-identical to streaming."""
+        zero per-step host work; trajectory-identical to streaming.
+
+        chunk=K assembles fused-dispatch chunks (stage_chunk) on the
+        worker instead of single batches - the staging half of
+        steps_per_dispatch=K. HBM budget: K*(depth+1) batches resident
+        (docs/PERFORMANCE.md)."""
         from cxxnet_tpu.io.prefetch import StagedPrefetcher
-        return StagedPrefetcher(self.stage_batch, data_iter, depth)
+        return StagedPrefetcher(self.stage_batch, data_iter, depth,
+                                chunk=chunk, chunk_fn=self.stage_chunk)
 
     def update(self, batch) -> None:
         """One training mini-batch (CXXNetThreadTrainer::Update).
-        Accepts a DataBatch (streamed: per-step pad/cast/H2D) or a
-        StagedBatch (device-resident: zero per-step host work)."""
-        import time as _time
+        Accepts a DataBatch (streamed: per-step pad/cast/H2D), a
+        StagedBatch (device-resident: zero per-step host work), or a
+        StagedChunk (fused: K microsteps in one dispatch)."""
+        if isinstance(batch, StagedChunk):
+            return self.update_chunk(batch)
         track = bool(self.profile) or self._tel_steps
-        t0 = _time.perf_counter() if track else 0.0
+        t0 = time.perf_counter() if track else 0.0
         if not isinstance(batch, StagedBatch):
             # the streamed path IS one stage_batch call - structural
             # guarantee of the staged/streamed trajectory equivalence.
@@ -884,7 +1007,7 @@ class NetTrainer:
         if track:
             # host-side prep (padding, casting, H2D staging) vs device
             # step, reported separately by StepProfiler.summary
-            t1 = _time.perf_counter()
+            t1 = time.perf_counter()
             data_s = t1 - t0
             if self.profiler is not None:
                 self.profiler.add_data(data_s)
@@ -898,7 +1021,8 @@ class NetTrainer:
             # prefetch still overlaps on its worker thread)
             self.state, loss, finite = self._train_step(
                 self.state, gdata, gextras, glabels, gmask, rng)
-            self._guard_step(finite)
+            ok = bool(np.asarray(distributed.fetch_local(finite)))
+            self._guard_step(ok, self._step_counter - 1)
         else:
             self.state, loss = self._train_step(
                 self.state, gdata, gextras, glabels, gmask, rng)
@@ -913,7 +1037,7 @@ class NetTrainer:
             # always paid; staging prefetch still overlaps on its
             # worker thread) - the price of honest step times
             jax.block_until_ready(self.state["epoch"])
-            step_s = _time.perf_counter() - t0
+            step_s = time.perf_counter() - t0
             if self.profiler is not None:
                 # distinct-instance count: wrap/pad rows in
                 # num_batch_padd would inflate images/sec
@@ -933,11 +1057,83 @@ class NetTrainer:
                           round=self.round, step=step_idx,
                           loss=loss_val, examples=n_examples)
 
-    def _guard_step(self, finite) -> None:
+    def update_chunk(self, chunk) -> None:
+        """K training microsteps in ONE dispatch (steps_per_dispatch):
+        a jitted lax.scan over a StagedChunk - accepts a sequence of
+        DataBatch/StagedBatch too (staged + stacked here). One host
+        readback per chunk serves the divergence guard, loss gauge and
+        per-step accounting for all K microsteps. Trajectory-bitwise-
+        identical to K update() calls; the one semantic difference is
+        that a DivergenceError can surface up to K-1 microsteps after
+        the fatal one (the chunk has already run on device), with the
+        in-jit rollback semantics unchanged."""
+        track = bool(self.profile) or self._tel_steps
+        t0 = time.perf_counter() if track else 0.0
+        if not isinstance(chunk, StagedChunk):
+            # staging validates; a rejected batch must raise BEFORE
+            # the step counter moves (same contract as update())
+            chunk = self.stage_chunk(chunk)
+        k = chunk.n_steps
+        base_rng = jax.random.PRNGKey(self.seed + 100)
+        first_step = self._step_counter
+        step_idx = distributed.put_global(
+            np.arange(first_step, first_step + k, dtype=np.int32),
+            self._replicated)
+        self._step_counter += k
+        data_s = 0.0
+        if track:
+            t1 = time.perf_counter()
+            data_s = t1 - t0
+            if self.profiler is not None:
+                self.profiler.add_data(data_s)
+            t0 = t1
+        self.state, losses, finites = self._train_chunk(
+            self.state, chunk.data, chunk.extras, chunk.labels,
+            chunk.mask, step_idx, base_rng)
+        if self._check_nan_built:
+            # ONE readback per chunk (vs one per step streamed) - the
+            # whole point of the fused dispatch; the guard then walks
+            # the per-microstep flags in order, so drop counts and
+            # consecutive-failure accounting match streaming exactly
+            fin = np.asarray(distributed.fetch_local(finites))
+            for i in range(k):
+                self._guard_step(bool(fin[i]), first_step + i)
+        self.epoch = self._epoch_base + (
+            (self._step_counter - self._skipped_steps)
+            // self.update_period)
+        if track:
+            jax.block_until_ready(self.state["epoch"])
+            chunk_s = time.perf_counter() - t0
+            n_examples = sum(chunk.n_examples)
+            if self.profiler is not None:
+                self.profiler.add_chunk(chunk_s, k, n_examples)
+            if self._tel_steps:
+                tel = telemetry.get()
+                loss_v = np.asarray(distributed.fetch_local(losses),
+                                    np.float64)
+                per_s = chunk_s / k
+                for _ in range(k):
+                    # per-step amortized cost: keeps the registry's
+                    # windowed p50/p99 on a per-STEP scale, comparable
+                    # across steps_per_dispatch settings (data_s too -
+                    # a non-prefetched chunk stages all K batches here,
+                    # and a per-chunk sample would read as a Kx staging
+                    # regression next to a K=1 run)
+                    tel.observe("train.step_s", per_s)
+                    tel.observe("train.data_s", data_s / k)
+                tel.inc("train.images", n_examples)
+                tel.set_gauge("train.loss", float(loss_v[-1]))
+                tel.event("span", name="train.data", secs=data_s,
+                          round=self.round, step=first_step)
+                tel.event("span", name="train.chunk", secs=chunk_s,
+                          round=self.round, step=first_step, steps=k,
+                          loss=[float(v) for v in loss_v],
+                          examples=n_examples)
+
+    def _guard_step(self, ok: bool, step_idx: int) -> None:
         """Host half of the divergence guard: count dropped steps and
         abort after max_bad_rounds CONSECUTIVE non-finite steps (the
         jitted step already rolled the state back)."""
-        ok = bool(np.asarray(distributed.fetch_local(finite)))
         if ok:
             self._bad_consec = 0
             return
@@ -947,11 +1143,11 @@ class NetTrainer:
         telemetry.inc("fault.nan_rollback")
         telemetry.stderr(
             f"divergence guard: non-finite loss/params at update "
-            f"{self._step_counter - 1}; batch dropped, params rolled "
+            f"{step_idx}; batch dropped, params rolled "
             f"back ({self._bad_consec}/{self.max_bad_rounds} "
             f"consecutive)\n",
             event_kind="fault", type="nan_rollback",
-            step=self._step_counter - 1, consecutive=self._bad_consec,
+            step=step_idx, consecutive=self._bad_consec,
             max_bad_rounds=self.max_bad_rounds)
         if self._bad_consec >= self.max_bad_rounds:
             raise DivergenceError(
@@ -1019,12 +1215,14 @@ class NetTrainer:
                      for k, v in labels.items()},
                     distributed.put_global(mask.astype(np.float32), shd),
                     rng))
-                if step % 8 == 0:
+                if self.eval_inflight and step % self.eval_inflight == 0:
                     # bound in-flight work: without a periodic sync the
                     # host loop stages the whole dataset's input
                     # buffers ahead of the device (HBM blow-up on large
                     # eval sets); syncing on the tiny metric rows keeps
-                    # <=8 batches of inputs pinned
+                    # <= eval_inflight batches of inputs pinned. The
+                    # knob trades HBM headroom for sync stalls
+                    # (docs/PERFORMANCE.md); 0 = never sync
                     jax.block_until_ready(per_batch[-1])
             # host-side float64 reduction across batches (the host
             # MetricSet path accumulated in f64; per-batch f32 sums are

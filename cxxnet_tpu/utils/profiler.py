@@ -65,6 +65,18 @@ class StepProfiler:
     def add_data(self, seconds: float) -> None:
         self.data_s.append(seconds)
 
+    def add_chunk(self, seconds: float, n_steps: int,
+                  n_examples: int) -> None:
+        """One fused dispatch of n_steps microsteps (trainer
+        update_chunk): recorded as n_steps equal per-step entries so
+        stats()/summary() keep reporting PER-STEP p50/p99 and
+        images/sec comparable across steps_per_dispatch settings
+        (the chunk total is preserved: sum == seconds)."""
+        n = max(1, int(n_steps))
+        per = seconds / n
+        self.step_s.extend([per] * n)
+        self.examples += n_examples
+
     # -- reporting ---------------------------------------------------------
     def stats(self) -> Optional[Dict[str, float]]:
         """Round stats as a JSON-ready dict (None when no steps ran).

@@ -187,6 +187,14 @@ def train(cfg: str, data, label, num_round: int,
             staged = [net._net.stage_batch(_batch_from_numpy(
                 data[i:i + batch_size], label[i:i + batch_size]))
                 for i in range(0, n, batch_size)]
+            if net._net.steps_per_dispatch > 1:
+                # fused dispatch (docs/PERFORMANCE.md): stack the
+                # device-resident batches into K-step chunks ONCE;
+                # each round then costs one dispatch per chunk
+                # (update() routes StagedChunk to update_chunk)
+                k = net._net.steps_per_dispatch
+                staged = [net._net.stage_chunk(staged[i:i + k])
+                          for i in range(0, len(staged), k)]
         except Exception:  # noqa: BLE001 - staging is an optimization
             staged = None
     pf = None
@@ -208,7 +216,10 @@ def train(cfg: str, data, label, num_round: int,
                 return _batch_from_numpy(data[i:i + batch_size],
                                          label[i:i + batch_size])
 
-        pf = net._net.prefetch(_Slices(), depth=1)
+        # chunk=K assembles fused-dispatch chunks on the worker when
+        # steps_per_dispatch is configured (1 = unchanged streaming)
+        pf = net._net.prefetch(_Slices(), depth=1,
+                               chunk=net._net.steps_per_dispatch)
     try:
         for r in range(num_round):
             net.start_round(r)
