@@ -1674,8 +1674,29 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
             _physics_check(out, peak_tflops, ndev)
             _derive(out, batch, platform, ndev, peak_tflops)
             _snapshot(out)
+    _measure_graftlint(out)
+    _snapshot(out)
     _finalize(out, platform)
     return out
+
+
+def _measure_graftlint(out: dict) -> None:
+    """Wall-time of the tier-1 static-analysis pass over the full
+    package tree (docs/STATIC_ANALYSIS.md) - the analysis itself gets
+    a perf trajectory, with a < 10 s CI budget the blocking job
+    enforces (--max-seconds). Guarded like every extra: a failure
+    degrades to graftlint_error, never kills the headline."""
+    try:
+        from cxxnet_tpu.analysis.astlint import lint_paths
+        pkg = os.path.join(_REPO, "cxxnet_tpu")
+        findings, n_files, elapsed = lint_paths([pkg])
+        out["graftlint_s"] = round(elapsed, 3)
+        out["graftlint_files"] = n_files
+        out["graftlint_unwaived"] = sum(
+            1 for f in findings if not f.waived)
+        out["graftlint_budget_s"] = 10.0
+    except Exception as e:  # noqa: BLE001 - extras must not kill bench
+        out["graftlint_error"] = f"{type(e).__name__}: {e}"
 
 
 def _finalize(out: dict, platform: str) -> None:

@@ -1,0 +1,39 @@
+"""graftlint: framework-aware static analysis (docs/STATIC_ANALYSIS.md).
+
+The compiler never checks the invariants this framework's correctness
+hangs on - RNG streams folded from (seed, step_counter), donated
+buffers that must not be reused, host syncs on the dispatch hot path,
+and `key = value` configs where a typo silently changes a run. Two
+tiers verify them statically:
+
+- **tier 1, AST lint** (astlint.py): stdlib-``ast`` rules over the
+  Python source, with stable GLxxx ids, per-line waivers
+  (``# graftlint: disable=GL004 reason``) and text/JSON reporters.
+  No jax import - runs anywhere in well under the 10 s CI budget.
+- **tier 2, jaxpr/HLO audit** (jaxpr_audit.py): trace the REAL
+  train/eval executables for a representative config and assert on
+  the lowered artifact - no f64 leaks, no host callbacks, buffer
+  donation actually applied, no weight-sized captured constants, and
+  a stable recompile count across a round with a short final chunk.
+
+Plus the **config schema registry** (schema.py): every recognized
+config key, generated from the source tree's ``set_param`` handlers,
+with did-you-mean suggestions for unknown keys. The CLI wires it into
+normal config parsing (main.py); ``--check-configs`` sweeps conf
+trees.
+
+CLI: ``python -m cxxnet_tpu.analysis [paths] [--check-configs DIR]
+[--jaxpr-audit] [--json FILE]`` - exit 0 iff zero unwaived findings
+and every audit check passed. CI runs it as a blocking job.
+"""
+
+from cxxnet_tpu.analysis.astlint import (
+    Finding, RULES, lint_paths, render_text)
+from cxxnet_tpu.analysis.schema import (
+    KeyRegistry, get_registry, suggest, unknown_keys, validate_pairs)
+
+__all__ = [
+    "Finding", "RULES", "lint_paths", "render_text",
+    "KeyRegistry", "get_registry", "suggest", "unknown_keys",
+    "validate_pairs",
+]

@@ -1,0 +1,188 @@
+"""graftlint CLI - the blocking CI gate (docs/STATIC_ANALYSIS.md).
+
+    python -m cxxnet_tpu.analysis [paths...] [options]
+
+Modes (combinable; each contributes to the exit code and the JSON
+report):
+
+  paths...              tier-1 AST lint over .py trees (default mode;
+                        with no paths, lints the cxxnet_tpu package)
+  --check-configs DIR   config schema sweep: every *.conf under DIR
+                        validated against the generated key registry
+  --jaxpr-audit         tier-2: trace the real train/eval executables
+                        and assert on the lowered artifact (imports
+                        jax - run under JAX_PLATFORMS=cpu in CI)
+
+Options:
+
+  --json FILE           write the combined machine-readable report
+  --rules GL001,GL004   restrict tier-1 to a rule subset
+  --show-waived         list waived findings in the text output
+  --list-rules          print the rule catalog and exit
+  --dump-keys           print the generated config-key registry
+  --max-seconds S       fail if the tier-1 lint exceeded S seconds
+                        (the CI perf budget for the analysis pass)
+
+Exit codes: 0 = clean (zero unwaived findings, all audit checks
+pass), 1 = findings/audit failures, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from cxxnet_tpu.analysis import schema
+from cxxnet_tpu.analysis.astlint import (
+    RULES, lint_paths, render_text)
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+_DEFAULT_PATH = os.path.dirname(_PKG)
+
+
+def _find_confs(root: str) -> List[str]:
+    if os.path.isfile(root):
+        return [root]
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if not d.startswith(".")]
+        out.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                   if f.endswith(".conf"))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cxxnet_tpu.analysis",
+        description="graftlint: framework-aware static analysis")
+    ap.add_argument("paths", nargs="*", help="python trees to lint")
+    ap.add_argument("--check-configs", action="append", default=[],
+                    metavar="DIR")
+    ap.add_argument("--jaxpr-audit", action="store_true")
+    ap.add_argument("--json", dest="json_out", default="")
+    ap.add_argument("--rules", default="")
+    ap.add_argument("--show-waived", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--dump-keys", action="store_true")
+    ap.add_argument("--max-seconds", type=float, default=0.0)
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, name in sorted(RULES.items()):
+            print(f"{rid}  {name}")
+        return 0
+    if args.dump_keys:
+        reg = schema.get_registry()
+        for key in sorted(reg.exact):
+            print(f"{key:28s} {reg.exact[key][0]}")
+        for pfx, where in reg.prefixes:
+            print(f"{pfx + '*':28s} {where}")
+        for rx, where in reg.patterns:
+            print(f"{rx.pattern:28s} {where}")
+        return 0
+
+    report = {}
+    failed = False
+
+    # -- tier 1: AST lint ---------------------------------------------------
+    run_lint = bool(args.paths) or not (args.check_configs
+                                        or args.jaxpr_audit)
+    if run_lint:
+        paths = args.paths or [_DEFAULT_PATH]
+        # a missing path or an empty tree must FAIL, not vacuously
+        # pass - a renamed package would otherwise turn the blocking
+        # CI gate green-and-useless forever
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            print(f"graftlint: path(s) do not exist: {missing}")
+            return 2
+        rules = [r.strip() for r in args.rules.split(",")
+                 if r.strip()] or None
+        findings, n_files, elapsed = lint_paths(paths, rules)
+        if n_files == 0:
+            print(f"graftlint: no .py files under {paths} - "
+                  "refusing to pass an empty scan")
+            return 2
+        print(render_text(findings, n_files, elapsed,
+                          show_waived=args.show_waived))
+        unwaived = [f for f in findings if not f.waived]
+        report["lint"] = {
+            "files": n_files, "elapsed_s": round(elapsed, 3),
+            "findings": [f.to_dict() for f in findings],
+            "unwaived": len(unwaived),
+            "waived": sum(1 for f in findings if f.waived),
+        }
+        if unwaived:
+            failed = True
+        if args.max_seconds and elapsed > args.max_seconds:
+            print(f"graftlint: FAIL - lint took {elapsed:.2f}s, "
+                  f"budget is {args.max_seconds:.0f}s")
+            report["lint"]["over_budget"] = True
+            failed = True
+
+    # -- config schema sweep ------------------------------------------------
+    if args.check_configs:
+        missing = [r for r in args.check_configs
+                   if not os.path.exists(r)]
+        if missing:
+            print(f"config-schema: path(s) do not exist: {missing}")
+            return 2
+        confs = []
+        for root in args.check_configs:
+            confs.extend(_find_confs(root))
+        if not confs:
+            print(f"config-schema: no .conf files under "
+                  f"{args.check_configs} - refusing to pass an "
+                  "empty sweep")
+            return 2
+        results = []
+        n_bad = 0
+        for conf in confs:
+            try:
+                bad = schema.check_config_file(conf)
+            except Exception as e:  # parse error is a finding too
+                results.append({"conf": conf, "error": str(e)})
+                n_bad += 1
+                print(f"{conf}: parse error: {e}")
+                continue
+            results.append({"conf": conf, "unknown": [
+                {"key": k, "suggestion": s} for k, s in bad]})
+            for k, s in bad:
+                n_bad += 1
+                hint = f" (did you mean '{s}'?)" if s else ""
+                print(f"{conf}: unknown config key '{k}'{hint}")
+        print(f"config-schema: {len(confs)} conf file(s), "
+              f"{n_bad} unknown key(s)")
+        report["configs"] = {"files": len(confs), "unknown": n_bad,
+                             "results": results}
+        if n_bad:
+            failed = True
+
+    # -- tier 2: jaxpr/HLO audit --------------------------------------------
+    if args.jaxpr_audit:
+        from cxxnet_tpu.analysis.jaxpr_audit import run_audit
+        audit = run_audit()
+        for chk in audit["checks"]:
+            mark = "ok" if chk["ok"] else "FAIL"
+            print(f"  [{mark}] {chk['target']}: {chk['check']}"
+                  + (f" - {chk['detail']}" if chk.get("detail")
+                     else ""))
+        n_fail = sum(1 for c in audit["checks"] if not c["ok"])
+        print(f"jaxpr-audit: {len(audit['checks'])} checks, "
+              f"{n_fail} failed")
+        report["audit"] = audit
+        if n_fail:
+            failed = True
+
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

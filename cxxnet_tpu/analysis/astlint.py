@@ -1,0 +1,801 @@
+"""graftlint tier 1: framework-aware AST lint (stdlib only, no jax).
+
+Rules (stable ids - the waiver/CI contract; docs/STATIC_ANALYSIS.md):
+
+- **GL001 rng-key-reuse**: a ``PRNGKey``/``fold_in`` result consumed
+  by two jax.random sampling calls without a new fold/split between
+  them - the two draws are IDENTICAL, the classic silent-correlation
+  bug the (seed, step_counter) stream discipline exists to prevent.
+- **GL002 host-sync-in-hot-path**: ``float()`` / ``int()`` /
+  ``np.asarray`` / ``.item()`` / ``block_until_ready`` /
+  ``device_get`` inside a jit-traced function (a trace-time error or
+  a constant-folding trap) or a ``# graftlint: hot-path`` marked
+  function (a device sync that serializes async dispatch - every one
+  must be deliberate and waived with its reason).
+- **GL003 tracer-branch**: Python ``if``/``while`` branching on a
+  value derived from a jit-traced function's arguments (tracers) -
+  trace-time error, or silent specialization via weak typing. Static
+  projections (``.shape``/``.ndim``/``.dtype``/``len()``/
+  ``isinstance``) are exempt.
+- **GL004 wallclock-duration**: ``time.time()`` - durations must use
+  ``time.monotonic()`` (NTP step/slew makes wall-clock deltas lie);
+  genuine wall-clock TIMESTAMPS carry a waiver naming that purpose.
+- **GL005 donated-arg-reuse**: an argument passed in a
+  ``donate_argnums`` position of a jitted callable is read again
+  before being reassigned - donation hands XLA the buffer; the read
+  sees freed/aliased memory (jax only *warns*, at runtime, sometimes).
+- **GL006 unknown-config-key**: a string-literal subscript or
+  ``.get`` on a cfg-like dict whose key the config schema registry
+  (schema.py) does not recognize - a typo'd key silently reads the
+  default forever.
+- **GL090 bad-waiver**: a waiver without a reason, or naming an
+  unknown rule id. Waivers are documentation; undocumented ones are
+  findings themselves.
+- **GL091 unused-waiver**: a waiver that suppressed nothing - stale
+  after the code it excused was fixed; delete it.
+
+Waiver syntax, per line::
+
+    x = time.time()  # graftlint: disable=GL004 epoch timestamp
+    # graftlint: disable=GL002,GL005 readback is the guard's cost
+    ok = bool(np.asarray(flag))
+
+(a standalone waiver comment applies to the next line). Functions are
+marked hot-path with ``# graftlint: hot-path`` on the ``def`` line or
+the line above.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import time
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from cxxnet_tpu.analysis import schema
+
+RULES: Dict[str, str] = {
+    "GL001": "rng-key-reuse",
+    "GL002": "host-sync-in-hot-path",
+    "GL003": "tracer-branch",
+    "GL004": "wallclock-duration",
+    "GL005": "donated-arg-reuse",
+    "GL006": "unknown-config-key",
+    "GL090": "bad-waiver",
+    "GL091": "unused-waiver",
+}
+
+_WAIVE_RE = re.compile(
+    r"graftlint:\s*disable=([A-Za-z0-9_,\s]*?)(?:\s+(.*))?$")
+_HOT_RE = re.compile(r"graftlint:\s*hot-path\b")
+
+# jax.random calls that CONSUME a key (one draw per key). fold_in /
+# split / PRNGKey / key / key_data DERIVE - deriving twice is the
+# sanctioned pattern, drawing twice is the bug.
+_SAMPLERS = frozenset({
+    "uniform", "normal", "bernoulli", "randint", "permutation",
+    "shuffle", "categorical", "gumbel", "truncated_normal", "beta",
+    "gamma", "dirichlet", "choice", "exponential", "laplace",
+    "logistic", "poisson", "rademacher", "cauchy", "maxwell",
+    "bits", "ball", "orthogonal", "t", "loggamma", "binomial",
+})
+_KEY_MAKERS = frozenset({"PRNGKey", "fold_in", "key"})
+
+# attribute projections of a tracer that are static at trace time
+_STATIC_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size", "aval", "sharding", "weak_type",
+})
+_STATIC_CALLS = frozenset({"len", "isinstance", "type", "callable"})
+
+_SYNC_METHODS = frozenset({"item", "block_until_ready"})
+_NP_NAMES = frozenset({"np", "numpy", "onp"})
+_CAST_BUILTINS = frozenset({"float", "int", "bool"})
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    waived: bool = False
+    reason: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule, "name": RULES.get(self.rule, ""),
+            "path": self.path, "line": self.line, "col": self.col,
+            "message": self.message, "waived": self.waived,
+            "reason": self.reason,
+        }
+
+
+@dataclass
+class _Waiver:
+    rules: List[str]
+    reason: str
+    src_line: int      # where the comment sits
+    target_line: int   # the line it excuses
+    used: bool = False
+
+
+@dataclass
+class _FileCtx:
+    path: str
+    rel: str
+    tree: ast.AST
+    waivers: List[_Waiver] = field(default_factory=list)
+    hot_lines: Set[int] = field(default_factory=set)
+    jitted: Set[str] = field(default_factory=set)
+    donated: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    findings: List[Finding] = field(default_factory=list)
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule=rule, path=self.rel, line=node.lineno,
+            col=node.col_offset, message=message))
+
+
+# ---------------------------------------------------------------------------
+# comments: waivers + hot-path markers
+# ---------------------------------------------------------------------------
+def _scan_comments(ctx: _FileCtx, source: str) -> None:
+    try:
+        toks = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return
+    lines = source.splitlines()
+    for tok in toks:
+        if tok.type != tokenize.COMMENT:
+            continue
+        line_no = tok.start[0]
+        before = lines[line_no - 1][:tok.start[1]]
+        standalone = not before.strip()
+        # a standalone waiver/marker comment applies to the NEXT line
+        target = line_no + 1 if standalone else line_no
+        if _HOT_RE.search(tok.string):
+            ctx.hot_lines.add(target)
+            continue
+        m = _WAIVE_RE.search(tok.string)
+        if not m:
+            continue
+        ids = [r.strip() for r in m.group(1).split(",") if r.strip()]
+        reason = (m.group(2) or "").strip()
+        w = _Waiver(rules=ids, reason=reason, src_line=line_no,
+                    target_line=target)
+        ctx.waivers.append(w)
+        bad = [r for r in ids if r not in RULES]
+        if bad or not ids:
+            ctx.findings.append(Finding(
+                "GL090", ctx.rel, line_no, tok.start[1],
+                f"waiver names unknown rule id(s) {bad or ids}"))
+        elif not reason:
+            ctx.findings.append(Finding(
+                "GL090", ctx.rel, line_no, tok.start[1],
+                f"waiver for {','.join(ids)} has no reason - say why "
+                "the finding is intended"))
+
+
+def _apply_waivers(ctx: _FileCtx) -> None:
+    for f in ctx.findings:
+        if f.rule in ("GL090", "GL091"):
+            continue  # waiver hygiene cannot be waived away
+        for w in ctx.waivers:
+            if f.line == w.target_line and f.rule in w.rules:
+                f.waived, f.reason = True, w.reason
+                w.used = True
+                break
+    for w in ctx.waivers:
+        if not w.used and all(r in RULES for r in w.rules) and w.rules:
+            ctx.findings.append(Finding(
+                "GL091", ctx.rel, w.src_line, 0,
+                f"waiver for {','.join(w.rules)} suppresses nothing - "
+                "stale, delete it"))
+
+
+# ---------------------------------------------------------------------------
+# module pass: jitted function names + donated-arg registry
+# ---------------------------------------------------------------------------
+def _last_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return _last_name(call.func) == "jit"
+
+
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+    return ()
+
+
+def _module_pass(ctx: _FileCtx) -> None:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            if node.args and isinstance(node.args[0], ast.Name):
+                ctx.jitted.add(node.args[0].id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                if _last_name(d) == "jit":
+                    ctx.jitted.add(node.name)
+        elif isinstance(node, ast.Assign):
+            v = node.value
+            if not (isinstance(v, ast.Call) and _is_jit_call(v)):
+                continue
+            donate = _donate_positions(v)
+            if not donate:
+                continue
+            for tgt in node.targets:
+                name = _last_name(tgt) if isinstance(
+                    tgt, (ast.Name, ast.Attribute)) else ""
+                if name:
+                    ctx.donated[name] = donate
+
+
+# ---------------------------------------------------------------------------
+# expression helpers
+# ---------------------------------------------------------------------------
+def _walk_no_funcs(node: ast.AST):
+    """ast.walk that does not descend into nested def/lambda (each
+    function is analyzed in its own visit, with its own scope)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _dynamic_names(expr: ast.expr) -> Set[str]:
+    """Names whose runtime VALUE the expression depends on - name
+    loads not shielded by a static projection (.shape, len(), ...)."""
+    if isinstance(expr, ast.Compare) and all(
+            isinstance(op, (ast.In, ast.NotIn)) for op in expr.ops):
+        # `k in params` on a pytree dict tests static KEYS, not
+        # values - only the left operand's value matters
+        return _dynamic_names(expr.left)
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return set()
+        return _dynamic_names(expr.value)
+    if isinstance(expr, ast.Call):
+        if (isinstance(expr.func, ast.Name)
+                and expr.func.id in _STATIC_CALLS):
+            return set()
+        out: Set[str] = set()
+        for child in ast.iter_child_nodes(expr):
+            if child is not expr.func:
+                out |= _dynamic_names(child)
+        out |= _dynamic_names(expr.func)
+        return out
+    if isinstance(expr, ast.Name):
+        return {expr.id} if isinstance(expr.ctx, ast.Load) else set()
+    if isinstance(expr, (ast.Lambda, ast.FunctionDef)):
+        return set()
+    out = set()
+    for child in ast.iter_child_nodes(expr):
+        if isinstance(child, ast.expr):
+            out |= _dynamic_names(child)
+        elif isinstance(child, ast.AST):
+            for sub in ast.walk(child):
+                if isinstance(sub, ast.Name) and isinstance(
+                        sub.ctx, ast.Load):
+                    out.add(sub.id)
+    return out
+
+
+def _assigned_names(target: ast.expr) -> Set[str]:
+    out = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+    return out
+
+
+def _expr_text(e: ast.expr) -> str:
+    try:
+        return ast.unparse(e)
+    except Exception:  # pragma: no cover - unparse of exotic nodes
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# GL001 rng-key-reuse
+# ---------------------------------------------------------------------------
+def _rule_rng_reuse(ctx: _FileCtx, fn: ast.AST) -> None:
+    # key var -> times consumed since last (re)derivation
+    consumed: Dict[str, int] = {}
+
+    def scan_expr(e: ast.expr) -> None:
+        for n in _walk_no_funcs_inclusive(e):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _last_name(n.func)
+            if name not in _SAMPLERS:
+                continue
+            args = list(n.args) + [kw.value for kw in n.keywords]
+            for a in args:
+                if (isinstance(a, ast.Name) and a.id in consumed):
+                    consumed[a.id] += 1
+                    if consumed[a.id] == 2:
+                        ctx.emit(
+                            "GL001", n,
+                            f"rng key '{a.id}' consumed twice "
+                            f"without a new fold_in/split - the two "
+                            f"draws are identical")
+
+    def scan_stmts(stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.Assign):
+                scan_expr(st.value)
+                tgts = set()
+                for t in st.targets:
+                    tgts |= _assigned_names(t)
+                maker = (isinstance(st.value, ast.Call)
+                         and _last_name(st.value.func) in _KEY_MAKERS)
+                for t in tgts:
+                    if maker and len(tgts) == 1:
+                        consumed[t] = 0       # fresh key
+                    else:
+                        consumed.pop(t, None)  # reassigned to non-key
+                continue
+            if isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+                if st.value is not None:
+                    scan_expr(st.value)
+                consumed.pop(
+                    next(iter(_assigned_names(st.target)), ""), None)
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                scan_expr(st.test)
+                snap = dict(consumed)
+                scan_stmts(st.body)
+                after_body = dict(consumed)
+                consumed.clear()
+                consumed.update(snap)
+                scan_stmts(st.orelse)
+                for k, v in after_body.items():
+                    if k in consumed:
+                        consumed[k] = max(consumed[k], v)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                scan_expr(st.iter)
+                for t in _assigned_names(st.target):
+                    consumed.pop(t, None)
+                scan_stmts(st.body)
+                scan_stmts(st.orelse)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    scan_expr(item.context_expr)
+                scan_stmts(st.body)
+                continue
+            if isinstance(st, ast.Try):
+                scan_stmts(st.body)
+                for h in st.handlers:
+                    scan_stmts(h.body)
+                scan_stmts(st.orelse)
+                scan_stmts(st.finalbody)
+                continue
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    scan_expr(child)
+
+    body = fn.body if isinstance(
+        fn, (ast.FunctionDef, ast.AsyncFunctionDef)) else []
+    scan_stmts(body)
+
+
+def _walk_no_funcs_inclusive(node: ast.AST):
+    yield node
+    yield from _walk_no_funcs(node)
+
+
+# ---------------------------------------------------------------------------
+# GL002 host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+def _rule_host_sync(ctx: _FileCtx, fn: ast.AST, kind: str) -> None:
+    fname = getattr(fn, "name", "<lambda>")
+    for n in _walk_no_funcs(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        func = n.func
+        what = ""
+        if (isinstance(func, ast.Name)
+                and func.id in _CAST_BUILTINS and len(n.args) == 1
+                and not isinstance(n.args[0], ast.Constant)):
+            # hot-path (plain python) functions: a cast of a bare
+            # name/attr/subscript is host arithmetic, not a readback -
+            # only casts of a COMPUTED value (the float(np.asarray(
+            # fetch_local(x))) shape) sync. Under jit every cast of a
+            # tracer is a trace-time error, so all of them flag.
+            if kind == "hot-path" and not any(
+                    isinstance(sub, ast.Call)
+                    for sub in ast.walk(n.args[0])):
+                what = ""
+            else:
+                what = f"{func.id}()"
+        elif isinstance(func, ast.Attribute):
+            if func.attr in _SYNC_METHODS:
+                what = f".{func.attr}()"
+            elif (func.attr in ("asarray", "array")
+                  and isinstance(func.value, ast.Name)
+                  and func.value.id in _NP_NAMES):
+                what = f"{func.value.id}.{func.attr}()"
+            elif func.attr == "device_get":
+                what = "device_get()"
+        if what:
+            ctx.emit(
+                "GL002", n,
+                f"{what} in {kind} function '{fname}' forces a host "
+                f"sync (or a trace-time error under jit)")
+
+
+# ---------------------------------------------------------------------------
+# GL003 tracer-branch (jit-traced functions only)
+# ---------------------------------------------------------------------------
+def _rule_tracer_branch(ctx: _FileCtx, fn: ast.AST) -> None:
+    a = fn.args
+    tainted: Set[str] = {x.arg for x in (
+        list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs))}
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            tainted.add(extra.arg)
+
+    def scan(stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.Assign):
+                dyn = _dynamic_names(st.value) & tainted
+                for t in st.targets:
+                    for name in _assigned_names(t):
+                        if dyn:
+                            tainted.add(name)
+                        else:
+                            tainted.discard(name)
+            elif isinstance(st, (ast.If, ast.While)):
+                hits = _dynamic_names(st.test) & tainted
+                if hits:
+                    kw = ("while" if isinstance(st, ast.While)
+                          else "if")
+                    ctx.emit(
+                        "GL003", st,
+                        f"python `{kw}` branches on traced value(s) "
+                        f"{sorted(hits)} inside jit-traced function "
+                        f"'{fn.name}' - use lax.cond/lax.while_loop "
+                        f"(or a static .shape/.dtype test)")
+                scan(st.body)
+                scan(st.orelse)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                for name in _assigned_names(st.target):
+                    if _dynamic_names(st.iter) & tainted:
+                        tainted.add(name)
+                scan(st.body)
+                scan(st.orelse)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                scan(st.body)
+            elif isinstance(st, ast.Try):
+                scan(st.body)
+                for h in st.handlers:
+                    scan(h.body)
+                scan(st.orelse)
+                scan(st.finalbody)
+
+    scan(fn.body)
+
+
+# ---------------------------------------------------------------------------
+# GL004 wallclock-duration
+# ---------------------------------------------------------------------------
+def _rule_wallclock(ctx: _FileCtx) -> None:
+    # both alias forms: `from time import time as t` (bare-name call)
+    # and `import time as _time` (module-attribute call)
+    fn_aliases: Set[str] = set()
+    mod_aliases: Set[str] = {"time"}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for al in node.names:
+                if al.name == "time":
+                    fn_aliases.add(al.asname or al.name)
+        elif isinstance(node, ast.Import):
+            for al in node.names:
+                if al.name == "time":
+                    mod_aliases.add(al.asname or al.name)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Attribute) and f.attr == "time"
+               and isinstance(f.value, ast.Name)
+               and f.value.id in mod_aliases) or (
+            isinstance(f, ast.Name) and f.id in fn_aliases)
+        if hit:
+            ctx.emit(
+                "GL004", node,
+                "time.time() - durations must use time.monotonic() "
+                "(wall clock steps/slews under NTP); a genuine "
+                "timestamp needs a waiver naming that purpose")
+
+
+# ---------------------------------------------------------------------------
+# GL005 donated-arg-reuse
+# ---------------------------------------------------------------------------
+def _rule_donated_reuse(ctx: _FileCtx, fn: ast.AST) -> None:
+    if not ctx.donated:
+        return
+
+    # dead expr text -> (donating callee, line it was donated)
+    dead: Dict[str, Tuple[str, int]] = {}
+
+    def donations_in(stmt: ast.stmt) -> List[Tuple[str, ast.Call]]:
+        out = []
+        for n in _walk_no_funcs_inclusive(stmt):
+            if isinstance(n, ast.Call):
+                name = _last_name(n.func)
+                if name in ctx.donated:
+                    out.append((name, n))
+        return out
+
+    def loads_stores(stmt: ast.stmt, text: str):
+        """(first-load-node, stored?) of `text` in the statement."""
+        first_load = None
+        stored = False
+        for n in _walk_no_funcs_inclusive(stmt):
+            if not isinstance(n, (ast.Name, ast.Attribute)):
+                continue
+            if _expr_text(n) != text:
+                continue
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                stored = True
+            elif first_load is None:
+                first_load = n
+        return first_load, stored
+
+    def scan(stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            # compound statements only recurse - a donation inside a
+            # branch must not leak into the sibling branch's scan.
+            # if/else branches are EXCLUSIVE: each scans from the
+            # pre-branch state; only expressions dead on both paths
+            # stay dead after the join
+            if isinstance(st, ast.If):
+                snap = dict(dead)
+                scan(st.body)
+                after_body = dict(dead)
+                dead.clear()
+                dead.update(snap)
+                scan(st.orelse)
+                for text in list(dead):
+                    if text not in after_body:
+                        del dead[text]
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor, ast.While,
+                               ast.With, ast.AsyncWith, ast.Try)):
+                for body in (getattr(st, "body", None),
+                             getattr(st, "orelse", None),
+                             getattr(st, "finalbody", None)):
+                    if body:
+                        scan(body)
+                for h in getattr(st, "handlers", []) or []:
+                    scan(h.body)
+                continue
+            new_dead: Dict[str, Tuple[str, int]] = {}
+            dons = donations_in(st)
+            for callee, call in dons:
+                for pos in ctx.donated[callee]:
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if isinstance(arg, (ast.Name, ast.Attribute,
+                                        ast.Subscript)):
+                        t = _expr_text(arg)
+                        if t:
+                            new_dead[t] = (callee, call.lineno)
+            # reads of already-dead exprs in this statement
+            for text, (callee, dline) in list(dead.items()):
+                load, stored = loads_stores(st, text)
+                # the donating statement itself re-registers below;
+                # here only prior donations are live
+                if load is not None:
+                    ctx.emit(
+                        "GL005", load,
+                        f"'{text}' read after being DONATED to "
+                        f"{callee}() at line {dline} - the buffer "
+                        f"belongs to XLA now; rebind it from the "
+                        f"call's result first")
+                    del dead[text]
+                elif stored:
+                    del dead[text]
+            # register this statement's donations, then let its own
+            # assignment targets revive them (result rebinding)
+            dead.update(new_dead)
+            if isinstance(st, (ast.Assign, ast.AugAssign,
+                               ast.AnnAssign)):
+                targets = (st.targets
+                           if isinstance(st, ast.Assign)
+                           else [st.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, (ast.Name, ast.Attribute)):
+                            dead.pop(_expr_text(n), None)
+    scan(fn.body)
+
+
+# ---------------------------------------------------------------------------
+# GL006 unknown-config-key
+# ---------------------------------------------------------------------------
+def _cfg_like(expr: ast.expr, aliases: Set[str]) -> bool:
+    if isinstance(expr, ast.Name):
+        low = expr.id.lower()
+        return "cfg" in low or "conf" in low or expr.id in aliases
+    if isinstance(expr, ast.Attribute):
+        low = expr.attr.lower()
+        return "cfg" in low or "conf" in low
+    return False
+
+
+def _rule_cfg_keys(ctx: _FileCtx, fn: ast.AST) -> None:
+    reg = schema.get_registry()
+    # one-hop aliases: dc = self._daug_cfg
+    aliases: Set[str] = set()
+    for n in _walk_no_funcs(fn):
+        if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and _cfg_like(n.value, set())):
+            aliases.add(n.targets[0].id)
+
+    def check_key(node: ast.AST, key: str) -> None:
+        if reg.recognizes(key):
+            return
+        hint = reg.suggest(key)
+        extra = f" (did you mean '{hint}'?)" if hint else ""
+        ctx.emit(
+            "GL006", node,
+            f"config key '{key}' is not in the schema registry - no "
+            f"set_param handler consumes it{extra}")
+
+    for n in _walk_no_funcs(fn):
+        if (isinstance(n, ast.Subscript)
+                and isinstance(n.slice, ast.Constant)
+                and isinstance(n.slice.value, str)
+                and _cfg_like(n.value, aliases)):
+            check_key(n, n.slice.value)
+        elif (isinstance(n, ast.Call)
+              and isinstance(n.func, ast.Attribute)
+              and n.func.attr == "get"
+              and _cfg_like(n.func.value, aliases)
+              and n.args
+              and isinstance(n.args[0], ast.Constant)
+              and isinstance(n.args[0].value, str)):
+            check_key(n, n.args[0].value)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def _function_visits(ctx: _FileCtx) -> None:
+    """Visit every function with its jit/hot scope resolved."""
+
+    def visit(node: ast.AST, in_jit: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                decorated = any(
+                    _last_name(d.func if isinstance(d, ast.Call)
+                               else d) == "jit"
+                    for d in child.decorator_list)
+                jitted = in_jit or child.name in ctx.jitted or decorated
+                hot = child.lineno in ctx.hot_lines
+                _rule_rng_reuse(ctx, child)
+                _rule_donated_reuse(ctx, child)
+                _rule_cfg_keys(ctx, child)
+                if jitted:
+                    _rule_host_sync(ctx, child, "jit-traced")
+                    _rule_tracer_branch(ctx, child)
+                elif hot:
+                    _rule_host_sync(ctx, child, "hot-path")
+                visit(child, jitted)
+            else:
+                visit(child, in_jit)
+
+    visit(ctx.tree, False)
+
+
+def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
+    rel = rel or path
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source)
+    except (OSError, SyntaxError) as e:
+        return [Finding("GL090", rel, getattr(e, "lineno", 0) or 0, 0,
+                        f"file does not parse: {e}")]
+    ctx = _FileCtx(path=path, rel=rel, tree=tree)
+    _scan_comments(ctx, source)
+    _module_pass(ctx)
+    _rule_wallclock(ctx)
+    _function_visits(ctx)
+    _apply_waivers(ctx)
+    ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return ctx.findings
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__"
+                           and not d.startswith(".")]
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames)
+                       if f.endswith(".py"))
+    return out
+
+
+def lint_paths(
+        paths: Sequence[str],
+        rules: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], int, float]:
+    """Lint every .py under `paths`. Returns (findings, n_files,
+    elapsed_s). `rules` filters to a subset of rule ids."""
+    t0 = time.monotonic()
+    findings: List[Finding] = []
+    files = iter_py_files(paths)
+    for path in files:
+        findings.extend(lint_file(path, os.path.relpath(path)))
+    if rules:
+        keep = set(rules)
+        findings = [f for f in findings if f.rule in keep]
+    return findings, len(files), time.monotonic() - t0
+
+
+def render_text(findings: Sequence[Finding], n_files: int,
+                elapsed_s: float, show_waived: bool = False) -> str:
+    lines = []
+    unwaived = [f for f in findings if not f.waived]
+    for f in unwaived:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} "
+                     f"[{RULES.get(f.rule, '?')}] {f.message}")
+    n_waived = sum(1 for f in findings if f.waived)
+    if show_waived:
+        for f in findings:
+            if f.waived:
+                lines.append(
+                    f"{f.path}:{f.line}: {f.rule} waived: {f.reason}")
+    lines.append(
+        f"graftlint: {len(unwaived)} finding(s), {n_waived} waived, "
+        f"{n_files} files in {elapsed_s:.2f}s")
+    return "\n".join(lines)

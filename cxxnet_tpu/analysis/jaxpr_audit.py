@@ -1,0 +1,272 @@
+"""graftlint tier 2: audit the LOWERED artifact, not the source.
+
+Tier 1 trusts what the Python says; this tier inspects what we
+actually dispatch (the TVM/Relay argument - PAPERS.md): trace the
+real jitted executables of a representative trainer and assert on
+the jaxpr + StableHLO + compiled HLO:
+
+- **no-f64**: no float64 anywhere in the lowered module. An
+  accidental x64 leak (np.float64 scalar, JAX_ENABLE_X64 drift)
+  doubles bandwidth and silently changes trajectories.
+- **no-host-callback**: no `custom_call` to a python/io callback and
+  no infeed/outfeed - a host round-trip inside the step caps
+  throughput at the host, invisibly.
+- **donation-applied**: `donate_argnums` plumbed all the way through:
+  donated params carry `tf.aliasing_output` in the lowered module AND
+  the compiled HLO has a non-empty `input_output_alias` table. jax
+  only *warns* when donation is dropped; this makes it a CI failure.
+  (Non-donating executables are asserted alias-free, so the check
+  cannot pass vacuously.)
+- **no-captured-consts**: no weight-sized arrays baked into the
+  executable as constants (params must arrive as ARGUMENTS - a
+  captured weight re-embeds per compile and defeats donation).
+- **recompile-audit**: the executable count stays bounded across a
+  simulated round WITH a short final chunk - the PR 3 program-shape
+  trap: `steps_per_dispatch=K` retraces once per distinct chunk
+  length, so a round of 4+4+1 must cost exactly 2 `_train_chunk`
+  lowering cache entries (K=4 and the K=1 flush), stable across
+  rounds; padded short batches must NOT add `train_step`/eval
+  entries.
+
+Audited executables: `train_step`, `_train_chunk` (K=1 and K=4), and
+the eval pair (`eval_step`, `eval_metric_step`), over the tiny-MLP
+config the fused-dispatch smoke uses. Run under `JAX_PLATFORMS=cpu`
+in CI; the checks are artifact-level, so they hold for any backend
+that compiles the same programs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# weight-sized constant bound: the tiny net's legitimate lowering
+# constants (iota tables, padding masks) stay well under this; its
+# smallest weight (fc1: 36x16 f32) is 2.3 KiB and a captured one
+# grows with the model - 4 KiB separates the two populations
+_CONST_BYTES_MAX = 4096
+
+_CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:sg1] = tanh
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,36
+batch_size = 32
+dev = cpu
+eta = 0.3
+metric = error
+eval_train = 1
+silent = 1
+seed = 7
+"""
+
+
+def _make_trainer():
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    tr = NetTrainer()
+    for k, v in parse_config_string(_CONF):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def _batch(i: int, b: int = 32):
+    from cxxnet_tpu.io.data import DataBatch
+    rng = np.random.RandomState(100 + i)
+    return DataBatch(
+        data=rng.rand(b, 1, 1, 36).astype(np.float32),
+        label=(rng.randint(0, 3, size=(b, 1))
+               .astype(np.float32)))
+
+
+# ---------------------------------------------------------------------------
+# artifact checks
+# ---------------------------------------------------------------------------
+def _check(target: str, check: str, ok: bool,
+           detail: str = "") -> Dict[str, Any]:
+    return {"target": target, "check": check, "ok": bool(ok),
+            "detail": detail}
+
+
+_F64_RE = re.compile(r"\bf64\b|xf64>|tensor<f64>")
+_CALLBACK_RE = re.compile(
+    r"custom_call[^\n]*(callback|py_func)|infeed|outfeed",
+    re.IGNORECASE)
+
+
+def _audit_executable(target: str, jitfn, args: Tuple,
+                      donated: bool) -> List[Dict[str, Any]]:
+    checks: List[Dict[str, Any]] = []
+    lowered = jitfn.lower(*args)
+    text = lowered.as_text()
+
+    hits = _F64_RE.findall(text)
+    checks.append(_check(
+        target, "no-f64", not hits,
+        f"{len(hits)} f64 type(s) in lowered module" if hits else ""))
+
+    cb = _CALLBACK_RE.search(text)
+    checks.append(_check(
+        target, "no-host-callback", cb is None,
+        f"host transfer in lowered module: {cb.group(0)[:60]}"
+        if cb else ""))
+
+    n_alias = text.count("tf.aliasing_output")
+    ctext = lowered.compile().as_text()
+    has_compiled_alias = ("input_output_alias={" in ctext
+                          and "input_output_alias={}" not in ctext)
+    if donated:
+        checks.append(_check(
+            target, "donation-applied",
+            n_alias > 0 and has_compiled_alias,
+            f"{n_alias} aliased params in lowered module; compiled "
+            f"alias table {'present' if has_compiled_alias else 'MISSING'}"))
+    else:
+        checks.append(_check(
+            target, "no-spurious-donation",
+            n_alias == 0,
+            f"{n_alias} aliased params on a non-donating executable"
+            if n_alias else ""))
+
+    consts: List = []
+    try:
+        consts = list(jitfn.trace(*args).jaxpr.consts)
+    except AttributeError:
+        # .trace needs jax >= 0.4.27; fall back to "unverifiable"
+        checks.append(_check(
+            target, "no-captured-consts", False,
+            "jit .trace() unavailable on this jax - cannot audit "
+            "captured constants"))
+        return checks
+    big = [c for c in consts
+           if getattr(c, "nbytes", 0) > _CONST_BYTES_MAX]
+    checks.append(_check(
+        target, "no-captured-consts", not big,
+        (f"{len(big)} constant(s) over {_CONST_BYTES_MAX} B captured "
+         f"(largest {max(c.nbytes for c in big)} B) - weights must "
+         "be arguments") if big else
+        f"{len(consts)} small consts"))
+    return checks
+
+
+# ---------------------------------------------------------------------------
+# recompile audit (the PR 3 program-shape trap)
+# ---------------------------------------------------------------------------
+def _cache_size(jitfn) -> Optional[int]:
+    fn = getattr(jitfn, "_cache_size", None)
+    return fn() if callable(fn) else None
+
+
+def _recompile_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
+    tr = _make_trainer()
+    if _cache_size(tr._train_step) is None:
+        checks.append(_check(
+            "recompile", "cache-size-api", False,
+            "jit._cache_size unavailable on this jax version"))
+        return {}
+
+    def round_of(k: int, n: int) -> None:
+        """One training pass: n batches dispatched in chunks of k
+        with the round-boundary short-chunk flush (main.py's loop)."""
+        pending = []
+        for i in range(n):
+            pending.append(_batch(i))
+            if len(pending) >= k:
+                tr.update_chunk(pending)
+                pending = []
+        if pending:
+            tr.update_chunk(pending)
+
+    # round 1: 9 batches at K=4 -> chunks 4+4+1 (short final chunk)
+    round_of(4, 9)
+    sizes = {"train_chunk_round1": _cache_size(tr._train_chunk)}
+    checks.append(_check(
+        "recompile", "chunk-cache==2 after 4+4+1 round",
+        sizes["train_chunk_round1"] == 2,
+        f"cache={sizes['train_chunk_round1']} (want 2: one K=4 "
+        f"executable + one short-chunk K=1)"))
+    # round 2, same shape mix: NO new executables
+    round_of(4, 9)
+    sizes["train_chunk_round2"] = _cache_size(tr._train_chunk)
+    checks.append(_check(
+        "recompile", "chunk-cache stable across rounds",
+        sizes["train_chunk_round2"] == sizes["train_chunk_round1"],
+        f"cache={sizes['train_chunk_round2']} after round 2"))
+
+    # streamed path: full batch + SHORT batch (padded to static
+    # shape) must share one train_step executable
+    tr.update(_batch(50))
+    tr.update(_batch(51, b=20))
+    sizes["train_step"] = _cache_size(tr._train_step)
+    checks.append(_check(
+        "recompile", "step-cache==1 incl. padded short batch",
+        sizes["train_step"] == 1,
+        f"cache={sizes['train_step']} (padding must keep the "
+        f"program shape static)"))
+
+    # eval executable: full + short batch, one program
+    tr.predict(_batch(60))
+    tr.predict(_batch(61, b=20))
+    sizes["eval_step"] = _cache_size(tr._eval_step)
+    checks.append(_check(
+        "recompile", "eval-cache==1 incl. padded short batch",
+        sizes["eval_step"] == 1, f"cache={sizes['eval_step']}"))
+    return sizes
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+def run_audit() -> Dict[str, Any]:
+    """Trace + compile the representative executables and run every
+    artifact check. Returns {platform, checks, cache_sizes}."""
+    import jax
+    from cxxnet_tpu.parallel import distributed
+
+    checks: List[Dict[str, Any]] = []
+    tr = _make_trainer()
+    sb = tr.stage_batch(_batch(0))
+    rng = jax.random.PRNGKey(0)
+
+    checks += _audit_executable(
+        "train_step", tr._train_step,
+        (tr.state, sb.data, sb.extras, sb.labels, sb.mask, rng),
+        donated=True)
+
+    for k in (1, 4):
+        chunk = tr.stage_chunk([_batch(i) for i in range(k)])
+        step_idx = distributed.put_global(
+            np.arange(k, dtype=np.int32), tr._replicated)
+        checks += _audit_executable(
+            f"train_chunk[K={k}]", tr._train_chunk,
+            (tr.state, chunk.data, chunk.extras, chunk.labels,
+             chunk.mask, step_idx, rng),
+            donated=True)
+
+    checks += _audit_executable(
+        "eval_step", tr._eval_step,
+        (tr.state["params"], sb.data, sb.extras), donated=False)
+    if tr._eval_metric_step is not None:
+        checks += _audit_executable(
+            "eval_metric_step", tr._eval_metric_step,
+            (tr.state["params"], sb.data, sb.extras, sb.labels,
+             sb.mask, rng), donated=False)
+
+    cache_sizes = _recompile_audit(checks)
+    return {
+        "platform": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "checks": checks,
+        "cache_sizes": cache_sizes,
+        "failed": sum(1 for c in checks if not c["ok"]),
+    }

@@ -84,6 +84,7 @@ class StagedPrefetcher:
             target=self._run, name="staged-prefetch", daemon=True)
         self._thread.start()
 
+    # graftlint: hot-path (per-batch consumer path: no host syncs here)
     def next(self) -> bool:
         if self._closed:
             # close() is terminal for the current pass: a stray next()

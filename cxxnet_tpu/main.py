@@ -92,6 +92,10 @@ class LearnTask:
         self.device = "tpu"
         self.eval_train = 1
         self.test_on_server = 0
+        # config schema gate (docs/STATIC_ANALYSIS.md): unknown keys
+        # error with a did-you-mean suggestion instead of silently
+        # configuring nothing; schema_check = 0 bypasses
+        self.schema_check = 1
         self.cfg: List[Tuple[str, str]] = []
 
     # ------------------------------------------------------------------
@@ -101,10 +105,24 @@ class LearnTask:
             return 0
         for name, val in parse_config_file(argv[0]):
             self.set_param(name, val)
+        n_file_pairs = len(self.cfg)
         for arg in argv[1:]:
             if "=" in arg:
                 name, val = arg.split("=", 1)
                 self.set_param(name.strip(), val.strip())
+        if self.schema_check:
+            # fail BEFORE any backend/iterator is touched: a typo'd
+            # key must cost a ConfigError with a suggestion, not a
+            # silently-default run (valid configs print nothing, so
+            # the CLI byte-parity contract is untouched). File pairs
+            # and argv overrides are labeled separately - "in
+            # my.conf" for a typo that was actually on the command
+            # line sends the user grepping the wrong place
+            from cxxnet_tpu.utils.config import validate_known_keys
+            validate_known_keys(self.cfg[:n_file_pairs],
+                                source=argv[0])
+            validate_known_keys(self.cfg[n_file_pairs:],
+                                source="command-line override")
         # an explicit JAX_PLATFORMS env always beats the conf's `dev`
         # kind (which is advisory - parallel/mesh.py): without this, a
         # `dev = tpu` conf run under JAX_PLATFORMS=cpu still initializes
@@ -212,6 +230,8 @@ class LearnTask:
             self.log_format = val
         if name == "heartbeat_secs":
             self.heartbeat_secs = float(val)
+        if name == "schema_check":
+            self.schema_check = int(val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------

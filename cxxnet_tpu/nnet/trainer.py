@@ -981,6 +981,7 @@ class NetTrainer:
         return StagedPrefetcher(self.stage_batch, data_iter, depth,
                                 chunk=chunk, chunk_fn=self.stage_chunk)
 
+    # graftlint: hot-path
     def update(self, batch) -> None:
         """One training mini-batch (CXXNetThreadTrainer::Update).
         Accepts a DataBatch (streamed: per-step pad/cast/H2D), a
@@ -1021,6 +1022,7 @@ class NetTrainer:
             # prefetch still overlaps on its worker thread)
             self.state, loss, finite = self._train_step(
                 self.state, gdata, gextras, glabels, gmask, rng)
+            # graftlint: disable=GL002 the guard's documented sync: the finite flag must be read back before the next step commits
             ok = bool(np.asarray(distributed.fetch_local(finite)))
             self._guard_step(ok, self._step_counter - 1)
         else:
@@ -1036,6 +1038,7 @@ class NetTrainer:
             # per-step timing forces a device sync (same cost profile=1
             # always paid; staging prefetch still overlaps on its
             # worker thread) - the price of honest step times
+            # graftlint: disable=GL002 honest per-step timing requires the sync - profile/telemetry_steps opt-in only
             jax.block_until_ready(self.state["epoch"])
             step_s = time.perf_counter() - t0
             if self.profiler is not None:
@@ -1045,6 +1048,7 @@ class NetTrainer:
             if self._tel_steps:
                 tel = telemetry.get()
                 step_idx = self._step_counter - 1
+                # graftlint: disable=GL002 loss gauge readback, gated by telemetry_steps=1
                 loss_val = float(np.asarray(
                     distributed.fetch_local(loss)))
                 tel.observe("train.data_s", data_s)
@@ -1057,6 +1061,7 @@ class NetTrainer:
                           round=self.round, step=step_idx,
                           loss=loss_val, examples=n_examples)
 
+    # graftlint: hot-path
     def update_chunk(self, chunk) -> None:
         """K training microsteps in ONE dispatch (steps_per_dispatch):
         a jitted lax.scan over a StagedChunk - accepts a sequence of
@@ -1095,6 +1100,7 @@ class NetTrainer:
             # whole point of the fused dispatch; the guard then walks
             # the per-microstep flags in order, so drop counts and
             # consecutive-failure accounting match streaming exactly
+            # graftlint: disable=GL002 ONE guard readback per K-step chunk - the fused dispatch's whole point
             fin = np.asarray(distributed.fetch_local(finites))
             for i in range(k):
                 self._guard_step(bool(fin[i]), first_step + i)
@@ -1102,6 +1108,7 @@ class NetTrainer:
             (self._step_counter - self._skipped_steps)
             // self.update_period)
         if track:
+            # graftlint: disable=GL002 honest per-chunk timing requires the sync - profile/telemetry_steps opt-in only
             jax.block_until_ready(self.state["epoch"])
             chunk_s = time.perf_counter() - t0
             n_examples = sum(chunk.n_examples)
@@ -1109,6 +1116,7 @@ class NetTrainer:
                 self.profiler.add_chunk(chunk_s, k, n_examples)
             if self._tel_steps:
                 tel = telemetry.get()
+                # graftlint: disable=GL002 per-chunk loss readback, gated by telemetry_steps=1
                 loss_v = np.asarray(distributed.fetch_local(losses),
                                     np.float64)
                 per_s = chunk_s / k
@@ -1186,6 +1194,7 @@ class NetTrainer:
         return {nid: distributed.fetch_local(v)[:valid]
                 for nid, v in outs.items()}
 
+    # graftlint: hot-path
     def evaluate(self, data_iter, data_name: str) -> str:
         """Run eval metrics over an iterator; returns the reference-format
         string `\\tname-metric:value...` (nnet_impl-inl.hpp:224-245).
@@ -1223,12 +1232,14 @@ class NetTrainer:
                     # <= eval_inflight batches of inputs pinned. The
                     # knob trades HBM headroom for sync stalls
                     # (docs/PERFORMANCE.md); 0 = never sync
+                    # graftlint: disable=GL002 eval_inflight HBM bound: sync every N batches by design
                     jax.block_until_ready(per_batch[-1])
             # host-side float64 reduction across batches (the host
             # MetricSet path accumulated in f64; per-batch f32 sums are
             # exact at batch scale, the cross-batch sum is not)
             vals = np.zeros((len(specs), 2), np.float64)
             for r in per_batch:
+                # graftlint: disable=GL002 one tiny-row readback per eval batch, after the dataset dispatched
                 vals += np.asarray(distributed.fetch_local(r),
                                    np.float64)
             return metric_jit.format_metrics(data_name, specs, vals)

@@ -199,6 +199,7 @@ class Telemetry:
 
     # -- events ------------------------------------------------------------
     def _record(self, kind: str, fields: Dict) -> Dict[str, object]:
+        # graftlint: disable=GL004 `ts` is a wall-clock TIMESTAMP by design - multi-host streams merge by absolute time (docs/OBSERVABILITY.md)
         rec: Dict[str, object] = {"ts": time.time(), "kind": kind}
         rec.update(self._tags)
         rec.update(fields)

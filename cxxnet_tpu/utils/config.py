@@ -147,3 +147,16 @@ def parse_config_file(fname: str) -> List[Tuple[str, str]]:
     """Parse a config file into an ordered list of (name, value)."""
     with open(fname, "r", encoding="utf-8") as f:
         return list(ConfigIterator(f))
+
+
+def validate_known_keys(pairs: List[Tuple[str, str]],
+                        source: str = "") -> None:
+    """Schema check on parsed pairs: every key must be recognized by
+    some component's set_param handler (the generated registry of
+    analysis/schema.py) - an unknown key raises ConfigError with a
+    did-you-mean suggestion instead of silently configuring nothing
+    (the reference routes every pair to every component and nobody
+    owns the typo). The CLI runs this on every parsed config unless
+    `schema_check = 0`."""
+    from cxxnet_tpu.analysis import schema
+    schema.validate_pairs(pairs, source=source)

@@ -1,0 +1,471 @@
+"""graftlint tier 1: per-rule fixtures, waivers, schema, CLI gate.
+
+Every rule gets at least one true-positive and one must-not-flag
+case (docs/STATIC_ANALYSIS.md); the acceptance check pins ZERO
+unwaived findings on the shipped tree.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cxxnet_tpu.analysis import schema
+from cxxnet_tpu.analysis.astlint import lint_file, lint_paths
+from cxxnet_tpu.utils.config import ConfigError, validate_known_keys
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+PKG = os.path.join(REPO, "cxxnet_tpu")
+
+
+def _lint(tmp_path, src, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return lint_file(str(p), name)
+
+
+def _rules(findings, waived=False):
+    return [f.rule for f in findings if f.waived == waived]
+
+
+# ---------------------------------------------------------------------------
+# GL001 rng-key-reuse
+# ---------------------------------------------------------------------------
+def test_gl001_key_consumed_twice_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import jax
+def f(seed):
+    k = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(k, (3,))
+    b = jax.random.normal(k, (3,))
+    return a + b
+""")
+    assert _rules(fs) == ["GL001"]
+    assert "consumed twice" in fs[0].message
+
+
+def test_gl001_fold_in_between_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import jax
+def f(seed):
+    k = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(k, (3,))
+    k = jax.random.fold_in(k, 1)
+    b = jax.random.normal(k, (3,))
+    return a + b
+""")
+    assert _rules(fs) == []
+
+
+def test_gl001_derivation_is_not_consumption(tmp_path):
+    # folding two subkeys out of one parent is THE sanctioned pattern
+    fs = _lint(tmp_path, """
+import jax
+def f(seed):
+    k = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(jax.random.fold_in(k, 0), (3,))
+    b = jax.random.normal(jax.random.fold_in(k, 1), (3,))
+    return a + b
+""")
+    assert _rules(fs) == []
+
+
+def test_gl001_exclusive_branches_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import jax
+def f(seed, flag):
+    k = jax.random.PRNGKey(seed)
+    if flag:
+        return jax.random.uniform(k, (3,))
+    else:
+        return jax.random.normal(k, (3,))
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL002 host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+def test_gl002_sync_in_jitted_fn_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import jax, numpy as np
+def step(x):
+    y = np.asarray(x)
+    return float(x) + x.item()
+step_j = jax.jit(step)
+""")
+    assert sorted(_rules(fs)) == ["GL002", "GL002", "GL002"]
+
+
+def test_gl002_hot_path_marker(tmp_path):
+    fs = _lint(tmp_path, """
+import numpy as np
+# graftlint: hot-path
+def update(self, batch):
+    flag = bool(np.asarray(fetch(batch)))
+    jax.block_until_ready(batch)
+    return flag
+""")
+    assert sorted(_rules(fs)) == ["GL002", "GL002", "GL002"]
+
+
+def test_gl002_unmarked_function_not_flagged(tmp_path):
+    fs = _lint(tmp_path, """
+import numpy as np
+def helper(batch):
+    return float(np.asarray(batch))
+""")
+    assert _rules(fs) == []
+
+
+def test_gl002_hot_path_plain_host_cast_ok(tmp_path):
+    # bool(self.profile) is host arithmetic, not a device readback
+    fs = _lint(tmp_path, """
+# graftlint: hot-path
+def update(self, batch):
+    track = bool(self.profile)
+    n = float(batch[0])
+    return track, n
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL003 tracer-branch
+# ---------------------------------------------------------------------------
+def test_gl003_branch_on_tracer_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import jax
+def step(x):
+    y = x * 2
+    if y > 0:
+        return y
+    while x < 3:
+        x = x + 1
+    return -y
+step_j = jax.jit(step)
+""")
+    assert _rules(fs) == ["GL003", "GL003"]
+
+
+def test_gl003_static_projections_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import jax
+def step(x, params):
+    if x.shape[0] > 2:
+        x = x * 2
+    if len(x) > 3 and x.dtype == "float32":
+        x = x + 1
+    if "wmat" not in params:
+        x = x - 1
+    return x
+step_j = jax.jit(step)
+""")
+    assert _rules(fs) == []
+
+
+def test_gl003_closure_config_ok(tmp_path):
+    # branching on captured python config (update_period) is static
+    fs = _lint(tmp_path, """
+import jax
+def compile_step(update_period):
+    def step(x):
+        if update_period == 1:
+            return x
+        return x * 2
+    return jax.jit(step)
+""")
+    assert _rules(fs) == []
+
+
+def test_gl003_not_applied_outside_jit(tmp_path):
+    fs = _lint(tmp_path, """
+def plain(x):
+    if x > 0:
+        return x
+    return -x
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL004 wallclock-duration
+# ---------------------------------------------------------------------------
+def test_gl004_time_time_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import time
+from time import time as wall
+t0 = time.time()
+t1 = wall()
+""")
+    assert _rules(fs) == ["GL004", "GL004"]
+
+
+def test_gl004_module_alias_flags(tmp_path):
+    # `import time as _time; _time.time()` - the pre-PR 3 trainer
+    # idiom; the rule must see through module aliases too
+    fs = _lint(tmp_path, """
+import time as _time
+dur = _time.time()
+""")
+    assert _rules(fs) == ["GL004"]
+
+
+def test_gl004_monotonic_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import time
+t0 = time.monotonic()
+t1 = time.perf_counter()
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL005 donated-arg-reuse
+# ---------------------------------------------------------------------------
+def test_gl005_read_after_donation_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import jax
+def f(s, x):
+    return s + x
+g = jax.jit(f, donate_argnums=(0,))
+def run(state, xs):
+    out = g(state, xs)
+    return state.sum() + out
+""")
+    assert _rules(fs) == ["GL005"]
+    assert "DONATED" in fs[0].message
+
+
+def test_gl005_rebound_result_ok(tmp_path):
+    # the trainer idiom: the donated arg is rebound from the result
+    fs = _lint(tmp_path, """
+import jax
+def f(s, x):
+    return s + x, 0.0
+g = jax.jit(f, donate_argnums=(0,))
+def run(state, xs):
+    state, loss = g(state, xs)
+    return state.sum() + loss
+""")
+    assert _rules(fs) == []
+
+
+def test_gl005_exclusive_branches_ok(tmp_path):
+    # each branch donates + rebinds independently (trainer's
+    # check_nan if/else); the sibling branch must not see it dead
+    fs = _lint(tmp_path, """
+import jax
+def f(s, x):
+    return s + x
+g = jax.jit(f, donate_argnums=(0,))
+class T:
+    def run(self, xs, flag):
+        if flag:
+            self.state = g(self.state, xs)
+        else:
+            self.state = g(self.state, xs)
+        return self.state
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL006 unknown-config-key
+# ---------------------------------------------------------------------------
+def test_gl006_typo_key_flags_with_suggestion(tmp_path):
+    fs = _lint(tmp_path, """
+def read(cfg):
+    return cfg.get("batch_sizee", "0")
+""")
+    assert _rules(fs) == ["GL006"]
+    assert "batch_size" in fs[0].message
+
+
+def test_gl006_known_key_and_non_cfg_dict_ok(tmp_path):
+    fs = _lint(tmp_path, """
+def read(cfg, blob):
+    dc = cfg
+    a = dc["eta"]
+    b = cfg.get("batch_size")
+    c = blob["anything_at_all"]
+    return a, b, c
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+def test_waiver_same_line_and_standalone(tmp_path):
+    fs = _lint(tmp_path, """
+import time
+a = time.time()  # graftlint: disable=GL004 epoch stamp for records
+# graftlint: disable=GL004 another epoch stamp
+b = time.time()
+c = time.time()
+""")
+    assert _rules(fs, waived=True) == ["GL004", "GL004"]
+    assert _rules(fs) == ["GL004"]  # the unwaived third call
+    assert all(f.reason for f in fs if f.waived)
+
+
+def test_waiver_without_reason_is_gl090(tmp_path):
+    fs = _lint(tmp_path, """
+import time
+a = time.time()  # graftlint: disable=GL004
+""")
+    rules = _rules(fs)
+    assert "GL090" in rules
+    # the waiver still suppresses - but the missing reason is flagged
+    assert "GL004" not in rules
+
+
+def test_waiver_unknown_rule_is_gl090(tmp_path):
+    fs = _lint(tmp_path, """
+x = 1  # graftlint: disable=GL999 no such rule
+""")
+    assert _rules(fs) == ["GL090"]
+
+
+def test_unused_waiver_is_gl091(tmp_path):
+    fs = _lint(tmp_path, """
+import time
+a = time.monotonic()  # graftlint: disable=GL004 stale excuse
+""")
+    assert _rules(fs) == ["GL091"]
+
+
+# ---------------------------------------------------------------------------
+# config schema registry
+# ---------------------------------------------------------------------------
+def test_registry_recognizes_handler_and_pattern_keys():
+    reg = schema.get_registry()
+    for key in ("batch_size", "eta", "num_round", "model_dir",
+                "steps_per_dispatch", "path_img", "image_mean",
+                "io_retry", "schema_check", "param_server"):
+        assert reg.recognizes(key), key
+    for key in ("layer[0->1]", "metric[error,top]", "wmat:lr",
+                "bias:wd", "lr:schedule", "extra_data_shape[1]",
+                "label_vec[0,3)"):
+        assert reg.recognizes(key), key
+    assert not reg.recognizes("batch_sizee")
+    assert reg.suggest("batch_sizee") == "batch_size"
+
+
+def test_registry_records_provenance():
+    reg = schema.get_registry()
+    assert any("main.py" in w for w in reg.exact["num_round"])
+    assert any("trainer.py" in w for w in reg.exact["batch_size"])
+
+
+def test_validate_pairs_raises_with_suggestion():
+    with pytest.raises(ConfigError) as ei:
+        validate_known_keys([("batch_sizee", "64")], source="x.conf")
+    msg = str(ei.value)
+    assert "batch_sizee" in msg and "batch_size" in msg
+    assert "x.conf" in msg
+    # clean pairs pass silently
+    validate_known_keys([("batch_size", "64"), ("eta", "0.1")])
+
+
+@pytest.mark.parametrize("conf", sorted(
+    os.path.join(d, f)
+    for d, _, fs in os.walk(os.path.join(REPO, "examples"))
+    for f in fs if f.endswith(".conf")))
+def test_example_confs_schema_clean(conf):
+    assert schema.check_config_file(conf) == []
+
+
+def test_cli_schema_gate_rejects_typo(tmp_path):
+    from cxxnet_tpu.main import LearnTask
+    conf = tmp_path / "t.conf"
+    conf.write_text("batch_sizee = 4\n")
+    with pytest.raises(ConfigError, match="batch_size"):
+        LearnTask().run([str(conf)])
+
+
+def test_cli_schema_gate_labels_argv_overrides(tmp_path):
+    # a typo'd k=v OVERRIDE must not be blamed on the conf file
+    from cxxnet_tpu.main import LearnTask
+    conf = tmp_path / "t.conf"
+    conf.write_text("batch_size = 4\n")
+    with pytest.raises(ConfigError, match="command-line override"):
+        LearnTask().run([str(conf), "batch_sizee=8"])
+
+
+def test_cli_schema_gate_bypass(tmp_path):
+    from cxxnet_tpu.main import LearnTask
+    conf = tmp_path / "t.conf"
+    conf.write_text("batch_sizee = 4\nschema_check = 0\n")
+    # bypassed: the run proceeds past the schema gate and fails much
+    # later on the genuinely-missing net config - anything BUT the
+    # schema's ConfigError proves the gate honored the off switch
+    with pytest.raises(Exception) as ei:
+        LearnTask().run([str(conf)])
+    assert not isinstance(ei.value, ConfigError)
+
+
+# ---------------------------------------------------------------------------
+# the gate itself
+# ---------------------------------------------------------------------------
+def test_tree_has_zero_unwaived_findings():
+    """Acceptance: the shipped tree is clean, every remaining hit
+    carries a reasoned waiver."""
+    findings, n_files, _ = lint_paths([PKG])
+    unwaived = [f for f in findings if not f.waived]
+    assert unwaived == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in unwaived)
+    assert n_files > 50
+    waived = [f for f in findings if f.waived]
+    assert waived, "expected documented waivers in the tree"
+    assert all(f.reason for f in waived)
+
+
+def test_cli_exit_codes_and_json_report(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nt = time.time()\n")
+    report = tmp_path / "report.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu.analysis", str(bad),
+         "--json", str(report)],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 1
+    rep = json.loads(report.read_text())
+    assert rep["lint"]["unwaived"] == 1
+    assert rep["lint"]["findings"][0]["rule"] == "GL004"
+
+    good = tmp_path / "good.py"
+    good.write_text("import time\nt = time.monotonic()\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu.analysis", str(good),
+         "--check-configs", os.path.join(REPO, "examples")],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 unknown key(s)" in r.stdout
+
+
+def test_cli_refuses_vacuous_scan(tmp_path):
+    """A missing path or an empty tree must FAIL the gate, not pass
+    it - a renamed package would otherwise turn the blocking CI job
+    green-and-useless forever."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu.analysis",
+         str(tmp_path / "no_such_dir")],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu.analysis", str(empty)],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 2
+    r = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu.analysis",
+         "--check-configs", str(empty)],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 2

@@ -1,0 +1,61 @@
+"""graftlint tier 2: the lowered-artifact audit against the LIVE
+trainer executables (donation applied, no f64, no host callbacks, no
+captured weight constants, stable recompile counts - the PR 3
+program-shape trap guard). docs/STATIC_ANALYSIS.md."""
+
+import pytest
+
+from cxxnet_tpu.analysis import jaxpr_audit
+
+
+@pytest.fixture(scope="module")
+def audit():
+    return jaxpr_audit.run_audit()
+
+
+def _by(audit, target, check):
+    hits = [c for c in audit["checks"]
+            if c["target"] == target and c["check"] == check]
+    assert hits, f"missing check {target}/{check}"
+    return hits[0]
+
+
+def test_all_checks_pass(audit):
+    bad = [c for c in audit["checks"] if not c["ok"]]
+    assert not bad, "\n".join(
+        f"{c['target']}: {c['check']} - {c['detail']}" for c in bad)
+    assert audit["failed"] == 0
+
+
+@pytest.mark.parametrize("target", [
+    "train_step", "train_chunk[K=1]", "train_chunk[K=4]"])
+def test_donation_applied_on_train_executables(audit, target):
+    chk = _by(audit, target, "donation-applied")
+    assert chk["ok"], chk["detail"]
+    # the lowered module really carries aliased params
+    assert "aliased params" in chk["detail"]
+
+
+@pytest.mark.parametrize("target", ["eval_step", "eval_metric_step"])
+def test_eval_executables_do_not_donate(audit, target):
+    assert _by(audit, target, "no-spurious-donation")["ok"]
+
+
+@pytest.mark.parametrize("target", [
+    "train_step", "train_chunk[K=1]", "train_chunk[K=4]",
+    "eval_step", "eval_metric_step"])
+def test_no_f64_no_callbacks_no_consts(audit, target):
+    assert _by(audit, target, "no-f64")["ok"]
+    assert _by(audit, target, "no-host-callback")["ok"]
+    assert _by(audit, target, "no-captured-consts")["ok"]
+
+
+def test_recompile_counts(audit):
+    """A 4+4+1 round costs exactly 2 chunk executables (K=4 + the
+    short-chunk K=1), stays 2 on round 2, and padded short batches
+    add no step/eval programs."""
+    sizes = audit["cache_sizes"]
+    assert sizes["train_chunk_round1"] == 2
+    assert sizes["train_chunk_round2"] == 2
+    assert sizes["train_step"] == 1
+    assert sizes["eval_step"] == 1
