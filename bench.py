@@ -896,6 +896,39 @@ def _bench_fused(ctx) -> dict:
         return {"e2e_fused_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_zero(ctx) -> dict:
+    """e2e with ZeRO-2 weight-update sharding (zero_stage=2,
+    docs/parallel.md): gradients reduce-scattered over the data axis,
+    the optimizer update run on each device's 1/N shard, fresh
+    weights all-gathered. The derived `zero_over_e2e` ratio vs
+    `e2e_ips` prices the trade (less update FLOPs + state HBM vs the
+    extra gather latency); `opt_state_bytes_per_dev` is the measured
+    per-device optimizer-state footprint - the HBM claim as a gauge
+    through the telemetry registry, not an assertion (on a 1-device
+    mesh it simply equals the full state and the stage degrades to
+    replicated, which the ratio then shows as ~1.0). Second AlexNet
+    compile. Disable with CXN_BENCH_ZERO=0."""
+    if os.environ.get("CXN_BENCH_ZERO") == "0":
+        return {}
+    try:
+        import jax
+        from cxxnet_tpu import telemetry
+        tr = ctx.make(0, [("zero_stage", "2")])
+        out = {}
+        state_bytes = sum(
+            a.addressable_shards[0].data.nbytes
+            for a in jax.tree_util.tree_leaves(tr.state["ustate"]))
+        out["opt_state_bytes_per_dev"] = int(state_bytes)
+        telemetry.set_gauge("zero.opt_state_bytes_per_dev",
+                            float(state_bytes))
+        ips, n = _measure_e2e(tr, ctx.batch, ctx.steps)
+        out["zero2_ips"] = round(ips, 2)
+        out["zero2_steps"] = n
+        return out
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"zero2_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_pool_ties(make, batch, steps, platform: str) -> dict:
     """Compute-path throughput with `pool_grad = ties` (the reference's
     tie-duplicating max-pool backward) vs the bench flagship's
@@ -1067,6 +1100,7 @@ _MEASUREMENTS = (
      "compute"),
     ("e2e_prefetch", _bench_prefetch, "CXN_BENCH_PREFETCH", 150, "h2d"),
     ("fused", _bench_fused, "CXN_BENCH_FUSED", 150, "h2d"),
+    ("zero", _bench_zero, "CXN_BENCH_ZERO", 150, "h2d"),
     ("attention",
      lambda c: _bench_attention(c.platform), "CXN_BENCH_ATTN", 100,
      "compute"),
@@ -1105,6 +1139,7 @@ _GFLOP_PER_IMG = {
     "e2e_devicedata_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_prefetch_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_fused_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
+    "zero2_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_f32stage_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "device_augment_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_eval_train_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
@@ -1170,6 +1205,13 @@ def _derive(out: dict, batch: int, platform: str, ndev: int,
         out["fused_over_e2e"] = round(fused / e2e, 4)
     else:
         out.pop("fused_over_e2e", None)
+    zero = out.get("zero2_ips")
+    if zero and e2e:
+        # ZeRO-2 vs replicated update: >1 = the sharded update's FLOP/
+        # HBM saving beat its extra gather latency in this window
+        out["zero_over_e2e"] = round(zero / e2e, 4)
+    else:
+        out.pop("zero_over_e2e", None)
     if e2e:
         out["metric"] = "alexnet_b%d_%s_train_e2e" % (batch, platform)
         out["value"], out["value_is"] = e2e, "e2e"
@@ -1301,7 +1343,7 @@ _LAST_GOOD_PATH = os.path.join(_REPO, "docs", "last_good_tpu.json")
 # make them interpretable
 _LAST_GOOD_MAX_FIELDS = (
     "compute_ips", "e2e_ips", "e2e_devicedata_ips", "e2e_prefetch_ips",
-    "e2e_fused_ips",
+    "e2e_fused_ips", "zero2_ips",
     "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
     "resnet18_ips", "resnet18_devicedata_ips",
     "device_augment_ips", "chip_matmul_tflops", "attn_pallas_tflops",
@@ -1384,6 +1426,7 @@ _SYNC_SOURCE = {
     "e2e_devicedata_ips": "device_data",
     "e2e_prefetch_ips": "e2e_prefetch",
     "e2e_fused_ips": "fused",
+    "zero2_ips": "zero",
     "compute_poolties_ips": "pool_ties", "googlenet_ips": "googlenet",
     "googlenet_devicedata_ips": "googlenet",
     "resnet18_ips": "resnet18", "resnet18_devicedata_ips": "resnet18",

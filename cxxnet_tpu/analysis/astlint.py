@@ -28,6 +28,18 @@ Rules (stable ids - the waiver/CI contract; docs/STATIC_ANALYSIS.md):
   ``.get`` on a cfg-like dict whose key the config schema registry
   (schema.py) does not recognize - a typo'd key silently reads the
   default forever.
+- **GL007 unsharded-large-intermediate**: a jit-traced function in a
+  mesh-aware module (one importing Mesh/NamedSharding/PartitionSpec
+  or the parallel package) allocates a weight-tree-sized temporary -
+  ``zeros_like``/``ones_like``/``full_like``/``empty_like`` on a
+  params/grads/state tree, directly or as the mapped function of a
+  ``tree.map`` - without a sharding constraint on the same statement.
+  Under a multi-device mesh such a temporary materializes FULLY
+  REPLICATED on every device unless its layout is pinned (by
+  ``with_sharding_constraint``, or structurally by the jit's
+  out_shardings/donation - which is what a waiver documents): the
+  exact accidental-full-materialization the ZeRO stages exist to
+  remove (docs/parallel.md).
 - **GL090 bad-waiver**: a waiver without a reason, or naming an
   unknown rule id. Waivers are documentation; undocumented ones are
   findings themselves.
@@ -65,6 +77,7 @@ RULES: Dict[str, str] = {
     "GL004": "wallclock-duration",
     "GL005": "donated-arg-reuse",
     "GL006": "unknown-config-key",
+    "GL007": "unsharded-large-intermediate",
     "GL090": "bad-waiver",
     "GL091": "unused-waiver",
 }
@@ -94,6 +107,17 @@ _STATIC_CALLS = frozenset({"len", "isinstance", "type", "callable"})
 _SYNC_METHODS = frozenset({"item", "block_until_ready"})
 _NP_NAMES = frozenset({"np", "numpy", "onp"})
 _CAST_BUILTINS = frozenset({"float", "int", "bool"})
+
+# GL007: allocators that clone a (possibly weight-sized) layout, and
+# the value names that mark a tree as weight-sized. Mesh-awareness is
+# per MODULE (imports of the sharding machinery) - a mesh-less module
+# cannot replicate anything across devices.
+_ALLOCATORS = frozenset({"zeros_like", "ones_like", "full_like",
+                         "empty_like"})
+_WEIGHTY_RE = re.compile(
+    r"param|grad|accum|ustate|state|weight|moment", re.IGNORECASE)
+_MESH_IMPORT_NAMES = frozenset({"Mesh", "NamedSharding",
+                                "PartitionSpec", "shard_map"})
 
 
 @dataclass
@@ -129,6 +153,7 @@ class _FileCtx:
     path: str
     rel: str
     tree: ast.AST
+    mesh_aware: bool = False
     waivers: List[_Waiver] = field(default_factory=list)
     hot_lines: Set[int] = field(default_factory=set)
     jitted: Set[str] = field(default_factory=set)
@@ -229,6 +254,16 @@ def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
 
 def _module_pass(ctx: _FileCtx) -> None:
     for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if ("sharding" in mod or "parallel" in mod
+                    or any(al.name in _MESH_IMPORT_NAMES
+                           for al in node.names)):
+                ctx.mesh_aware = True
+        elif isinstance(node, ast.Import):
+            if any("sharding" in al.name or "parallel" in al.name
+                   for al in node.names):
+                ctx.mesh_aware = True
         if isinstance(node, ast.Call) and _is_jit_call(node):
             if node.args and isinstance(node.args[0], ast.Name):
                 ctx.jitted.add(node.args[0].id)
@@ -700,6 +735,71 @@ def _rule_cfg_keys(ctx: _FileCtx, fn: ast.AST) -> None:
 
 
 # ---------------------------------------------------------------------------
+# GL007 unsharded-large-intermediate (jit-traced, mesh-aware modules)
+# ---------------------------------------------------------------------------
+def _rule_unsharded_intermediate(ctx: _FileCtx, fn: ast.AST) -> None:
+    if not ctx.mesh_aware:
+        return
+    fname = getattr(fn, "name", "<lambda>")
+
+    def weighty(exprs: Sequence[ast.expr]) -> str:
+        for e in exprs:
+            for name in sorted(_dynamic_names(e)):
+                if _WEIGHTY_RE.search(name):
+                    return name
+        return ""
+
+    def stmt_has_constraint(st: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Call)
+            and _last_name(n.func) == "with_sharding_constraint"
+            for n in _walk_no_funcs_inclusive(st))
+
+    for st in _walk_no_funcs_inclusive(fn):
+        # simple statements only: the smallest enclosing statement is
+        # the waiver/constraint granularity, and walking compound
+        # statements too would double-count their bodies
+        if not isinstance(st, (ast.Assign, ast.AugAssign,
+                               ast.AnnAssign, ast.Return, ast.Expr)):
+            continue
+        if stmt_has_constraint(st):
+            continue
+        for n in _walk_no_funcs_inclusive(st):
+            if not isinstance(n, ast.Call):
+                continue
+            call = _last_name(n.func)
+            args = list(n.args) + [kw.value for kw in n.keywords]
+            if call in _ALLOCATORS:
+                src = weighty(args)
+                if src:
+                    ctx.emit(
+                        "GL007", n,
+                        f"{call}('{src}') builds a weight-sized "
+                        f"temporary in jit-traced function '{fname}' "
+                        f"with no sharding constraint - under a "
+                        f"multi-device mesh it materializes fully "
+                        f"replicated; pin it with "
+                        f"with_sharding_constraint (or waive naming "
+                        f"the out_shardings/donation that shards it)")
+            elif call == "map" and any(
+                    isinstance(a, (ast.Name, ast.Attribute))
+                    and _last_name(a) in _ALLOCATORS for a in n.args):
+                # jax.tree.map(jnp.zeros_like, tree): the mapped
+                # allocator clones every leaf of the tree
+                src = weighty(n.args[1:])
+                if src:
+                    ctx.emit(
+                        "GL007", n,
+                        f"tree.map of an allocator over '{src}' builds "
+                        f"a weight-tree-sized temporary in jit-traced "
+                        f"function '{fname}' with no sharding "
+                        f"constraint - under a multi-device mesh it "
+                        f"materializes fully replicated; pin it with "
+                        f"with_sharding_constraint (or waive naming "
+                        f"the out_shardings/donation that shards it)")
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 def _function_visits(ctx: _FileCtx) -> None:
@@ -721,6 +821,7 @@ def _function_visits(ctx: _FileCtx) -> None:
                 if jitted:
                     _rule_host_sync(ctx, child, "jit-traced")
                     _rule_tracer_branch(ctx, child)
+                    _rule_unsharded_intermediate(ctx, child)
                 elif hot:
                     _rule_host_sync(ctx, child, "hot-path")
                 visit(child, jitted)

@@ -127,7 +127,16 @@ class NetTrainer:
         self._loaded_params = None
         self._loaded_opt = None
         self.save_optimizer = 0
-        self.shard_optimizer = 0
+        # ZeRO weight-update sharding stage (docs/parallel.md,
+        # arXiv:2004.13336): 0 = fully replicated update; 1 = optimizer
+        # state sharded over 'data' (`shard_optimizer=1` stays as the
+        # legacy alias); 2 = + gradients reduce-scattered and the
+        # update run on each device's shard only, fresh weights
+        # all-gathered once per step; 3 = + parameters sharded BETWEEN
+        # steps, each weight all-gathered just in time for its layer
+        # in the forward pass
+        self.zero_stage = 0
+        self._zero_src = ""   # config key that last set zero_stage
         self.stage_dtype = ""   # "" = follow compute_dtype
         self.device_augment = 0
         # augment spec, shared config keys with the host iterator
@@ -197,15 +206,18 @@ class NetTrainer:
             self.silent = int(val)
         if name == "save_optimizer":
             self.save_optimizer = int(val)
+        if name == "zero_stage":
+            self._set_zero_stage(name, int(val))
         if name == "shard_optimizer":
-            self.shard_optimizer = int(val)
+            # legacy alias: ZeRO-1, optimizer state only
+            self._set_zero_stage(name, 1 if int(val) else 0)
         if name == "update_on_server" and int(val):
             # reference knob (nnet_ps_server.cpp): run the updater on
             # the PS instead of replicating it per worker. The TPU
             # analog is sharding the optimizer state (docs/parallel.md).
             # Enable-only: an explicit =0 (the reference default in
             # non-PS configs) must not clobber shard_optimizer=1.
-            self.shard_optimizer = 1
+            self._set_zero_stage(name, max(1, self.zero_stage))
         if name == "remat":
             self.remat = int(val)
         if name == "check_nan":
@@ -278,6 +290,34 @@ class NetTrainer:
                 self.train_metric.add_metric(val, "label")
                 self.eval_nodes.append(("", -1))
         self.cfg_pairs.append((name, val))
+
+    def _set_zero_stage(self, key: str, stage: int) -> None:
+        """zero_stage with alias handling: `shard_optimizer` /
+        `update_on_server` are legacy spellings of stage <= 1.
+        Last-writer-wins holds only WITHIN one key - an alias arriving
+        after an explicit `zero_stage = 2|3` must not silently
+        downgrade the run to ZeRO-1; it warns and is ignored."""
+        if not 0 <= stage <= 3:
+            raise ValueError("zero_stage must be 0, 1, 2 or 3")
+        if key != "zero_stage" and self._zero_src == "zero_stage":
+            if stage != self.zero_stage:
+                telemetry.stderr(
+                    f"warning: {key} (a zero_stage={stage} alias) "
+                    f"conflicts with the explicit zero_stage="
+                    f"{self.zero_stage}; keeping zero_stage="
+                    f"{self.zero_stage}\n",
+                    event_kind="config", type="zero_stage_conflict",
+                    key=key, requested=stage, kept=self.zero_stage)
+            # agreeing alias: the explicit setting stays authoritative
+            return
+        self.zero_stage = stage
+        self._zero_src = key
+
+    @property
+    def shard_optimizer(self) -> int:
+        """Legacy view of the ZeRO knob: any stage shards the
+        optimizer state (readers predate zero_stage)."""
+        return int(self.zero_stage >= 1)
 
     # ------------------------------------------------------------------
     # initialization
@@ -477,6 +517,45 @@ class NetTrainer:
 
     def _compile(self) -> None:
         net = self.net
+        # ZeRO effective stage for THIS mesh (docs/parallel.md): stages
+        # >= 2 need a real 'data' axis to cut over; a single-device or
+        # data-less mesh compiles the replicated stage-0 program (the
+        # same degradation rule zero1_shardings applies to stage 1)
+        dsize = self.mesh.shape.get("data", 1)
+        zrun = self.zero_stage if dsize > 1 else min(self.zero_stage, 1)
+        if zrun >= 2:
+            extra_axes = [a for a in self.mesh.axis_names
+                          if a not in ("data", "model")
+                          and self.mesh.shape[a] > 1]
+            if extra_axes:
+                raise ValueError(
+                    f"zero_stage={self.zero_stage} composes with "
+                    f"'data'/'model' mesh axes only; axes {extra_axes} "
+                    "drive layers that shard_map over the full mesh "
+                    "(ring/ulysses attention, pipelined stacks, moe), "
+                    "which cannot nest inside the manual-'data' ZeRO "
+                    "region - use zero_stage<=1 on seq/pipe/expert "
+                    "meshes")
+            for lk, d in self.updaters.items():
+                for pn, up in d.items():
+                    if not getattr(up, "zero_shardable", False):
+                        raise ValueError(
+                            f"updater '{up.kind or type(up).__name__}' "
+                            f"({lk}.{pn}) declares zero_shardable="
+                            "False (its math reduces over the full "
+                            "tensor, so a per-shard update computes "
+                            "different results); use zero_stage<=1")
+            for idx, _info in enumerate(self.net_cfg.layers):
+                lay = self.net.layer_objs[idx]
+                if (getattr(lay, "type_name", "") == "batch_norm"
+                        and getattr(lay, "global_stats", 0)):
+                    raise ValueError(
+                        "batch_norm global_stats=1 (sync-BN) needs "
+                        "global-batch statistics, but zero_stage>=2 "
+                        "runs the forward per data shard (per-shard "
+                        "stats, the reference's per-GPU semantics); "
+                        "use zero_stage<=1 with sync-BN")
+        self._zero_run = zrun
         eval_node_ids = sorted({nid for _, nid in self.eval_nodes})
         scale = 1.0 / (self.batch_size * self.update_period)
         update_period = self.update_period
@@ -549,6 +628,15 @@ class NetTrainer:
                     dc.get("max_random_illumination", "0")))
         self._augment_fn = daug
 
+        # zero_stage>=2 traces the TRAIN forward inside a manual-'data'
+        # shard_map region (per-device values): the mesh-keyed op
+        # routes (per-shard batch_norm, fullc_gather, Pallas device
+        # routes) must decline there - their plain per-device fallback
+        # IS the right semantics inside the region (batch_norm's local
+        # stats are bitwise the stats its shard_map route computes) -
+        # so the region binds no active mesh. Eval keeps self.mesh.
+        fwd_mesh = None if zrun >= 2 else self.mesh
+
         def loss_fn(params, data, extras, labels, mask, rng, step):
             cparams = self._cast(params)
             if daug is not None:
@@ -556,7 +644,7 @@ class NetTrainer:
             inputs = {0: self._cast(data)}
             for i, e in enumerate(extras):
                 inputs[1 + i] = self._cast(e)
-            with active_mesh(self.mesh), active_step(step):
+            with active_mesh(fwd_mesh), active_step(step):
                 values, loss = net.forward(
                     cparams, inputs, train=True, rng=rng,
                     labels=labels, mask=mask)
@@ -573,13 +661,90 @@ class NetTrainer:
             # scratch the same way)
             loss_fn = jax.checkpoint(loss_fn)
 
+        # ZeRO-2/3 sharding trees (parallel/sharding.py): the per-weight
+        # 'data' cut shared by optimizer state, gradients/accumulator
+        # and (stage 3) the parameters themselves
+        zdims = zshard = scatter_specs = gather_specs = None
+        zshapes = None
+        if zrun >= 2:
+            from cxxnet_tpu.parallel.sharding import (
+                zero2_shardings, zero_partition_dims, zero_region_specs)
+            # one abstract init trace shared by every zero helper (it
+            # scales with the model, and ZeRO targets big models)
+            zshapes = jax.eval_shape(net.init_params,
+                                     jax.random.PRNGKey(0))
+            zdims = zero_partition_dims(self.mesh, self.net,
+                                        self._pshard, zshapes)
+            zshard = zero2_shardings(self.mesh, self.net, self._pshard,
+                                     zshapes, zdims)
+            scatter_specs, gather_specs = zero_region_specs(
+                self.mesh, self.net, self._pshard, zshapes, zdims)
+
+        grad_inner = jax.value_and_grad(loss_fn, has_aux=True)
+        grad_and_loss = grad_inner
+        if zrun >= 2:
+            # The cross-replica weight-update sharding recipe
+            # (arXiv:2004.13336) needs the gradients in UNREDUCED
+            # per-device form - GSPMD only exposes them post-allreduce -
+            # so the fwd/bwd runs manual over 'data' (shard_map; every
+            # other mesh axis stays auto, i.e. the tensor-parallel
+            # 'model' placement keeps riding GSPMD) and ends in an
+            # explicit psum_scatter: the literal reduce-scatter the
+            # jaxpr audit asserts on. Everything after (accumulate,
+            # updater, counters, guard) stays plain GSPMD on the
+            # zero-sharded global values.
+            from cxxnet_tpu.parallel.sharding import shard_map_manual
+
+            def _scatter(grads):
+                # reduce-scatter eligible weights onto their zero cut;
+                # ineligible ones psum (replicated update, stage-0
+                # semantics for that tensor)
+                return {
+                    lk: {pn: (lax.psum(g, "data")
+                              if zdims[lk][pn] is None else
+                              lax.psum_scatter(
+                                  g, "data",
+                                  scatter_dimension=zdims[lk][pn],
+                                  tiled=True))
+                         for pn, g in d.items()}
+                    for lk, d in grads.items()}
+
+            def zero_region(params, data, extras, labels, mask, rng,
+                            step):
+                # per-device RNG stream: random layers (dropout, device
+                # augment) must not draw the same local pattern on
+                # every data shard
+                rng = jax.random.fold_in(rng, lax.axis_index("data"))
+                (loss, outs), grads = grad_inner(
+                    params, data, extras, labels, mask, rng, step)
+                return (lax.psum(loss, "data"), outs), _scatter(grads)
+
+            dspec = P("data")
+            # params enter replicated-over-'data' (P()): under stage 3
+            # they LIVE on their zero cut between steps, so GSPMD
+            # inserts one all-gather per weight at the region boundary
+            # - the just-in-time gather, one op per layer's weight,
+            # placed by the scheduler (a manual 'data' in_spec on a
+            # tensor that also rides the auto 'model' axis trips an
+            # XLA manual-subgroup partitioner check in this jax)
+            param_in = gather_specs
+            grad_and_loss = shard_map_manual(
+                zero_region, self.mesh, ("data",),
+                in_specs=(param_in, dspec,
+                          (dspec,) * self.net_cfg.extra_data_num,
+                          {f: dspec
+                           for f in self.net_cfg.label_name_map},
+                          dspec, P(), P()),
+                out_specs=((P(), {nid: dspec
+                                  for nid in eval_node_ids}),
+                           scatter_specs))
+
         def train_step(state, data, extras, labels, mask, rng):
             # per-forward training-step counter (updates so far) for
             # step-dependent layers (insanity anneal)
             step = state["epoch"] * update_period + state["count"]
-            (loss, outs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state["params"], data, extras,
-                                       labels, mask, rng, step)
+            (loss, outs), grads = grad_and_loss(
+                state["params"], data, extras, labels, mask, rng, step)
             if update_period == 1:
                 # state["accum"] is invariantly all-zero between
                 # updates; adding it would stream the whole gradient-
@@ -598,10 +763,22 @@ class NetTrainer:
                     for pn, up in d.items():
                         if lk not in params or pn not in params[lk]:
                             continue
-                        st, w = up.apply(ustate[lk][pn], params[lk][pn],
+                        w = params[lk][pn]
+                        if zrun == 2 and zdims[lk][pn] is not None:
+                            # slice the replicated weight down to this
+                            # device's zero shard (no comm - a local
+                            # dynamic-slice): the updater then runs at
+                            # 1/N FLOPs on shard-shaped state/grad, and
+                            # the params out_sharding all-gathers the
+                            # fresh weights once per update. Stage 3
+                            # skips the slice - params arrive sharded.
+                            w = lax.with_sharding_constraint(
+                                w, zshard[lk][pn])
+                        st, w = up.apply(ustate[lk][pn], w,
                                          accum[lk][pn], state["epoch"])
                         new_params[lk][pn] = w
                         new_ustate[lk][pn] = st
+                # graftlint: disable=GL007 the zero tree inherits accum's zero-stage sharding via donation/out_shardings
                 zero = jax.tree.map(jnp.zeros_like, accum)
                 return new_params, new_ustate, zero
 
@@ -689,19 +866,29 @@ class NetTrainer:
         # ustate prefix tree: one sharding per weight, prefixing the inner
         # updater-state dict ({m} / {m1,m2}); mirrors _init_state's filter
         ushard = self._pshard
-        if self.shard_optimizer:
+        if zrun >= 1:
             # ZeRO-1 / update_on_server analog: optimizer state sharded
             # over 'data' (parallel/sharding.py:zero1_shardings)
             from cxxnet_tpu.parallel.sharding import zero1_shardings
-            ushard = zero1_shardings(self.mesh, self.net, self._pshard)
+            ushard = zero1_shardings(self.mesh, self.net, self._pshard,
+                                     zshapes, zdims)
         ustate_prefix = {
             lk: {pn: ushard[lk][pn] for pn in d
                  if pn in ushard.get(lk, {})}
             for lk, d in self.updaters.items()}
         self._ustate_shard = ustate_prefix
+        # stage 3 keeps the PARAMETERS on their zero cut between steps;
+        # stage 2 additionally stores the update_period>1 accumulator
+        # sharded (each microstep reduce-scatters into it)
+        pstore = self._pshard
+        if zrun == 3:
+            from cxxnet_tpu.parallel.sharding import zero3_shardings
+            pstore = zero3_shardings(self.mesh, self.net, self._pshard,
+                                     zshapes, zdims)
+        self._params_store_shard = pstore
         state_shardings = {
-            "params": self._pshard, "ustate": ustate_prefix,
-            "accum": self._pshard,
+            "params": pstore, "ustate": ustate_prefix,
+            "accum": zshard if zrun >= 2 else self._pshard,
             "count": rep, "epoch": rep, "tmetric": rep,
         }
         self._state_shardings = state_shardings
@@ -775,14 +962,17 @@ class NetTrainer:
         self._stack_chunk = jax.jit(
             lambda *bs: jax.tree.map(lambda *ls: jnp.stack(ls), *bs),
             out_shardings=self._chunk_stack_shardings)
+        # eval consumes params at their BETWEEN-STEPS layout: under
+        # zero_stage=3 they arrive sharded and GSPMD inserts the
+        # gathers where the forward needs full tensors
         self._eval_step = jax.jit(
-            eval_step, in_shardings=(self._pshard, dshd, eshd),
+            eval_step, in_shardings=(pstore, dshd, eshd),
             out_shardings=shd)
         self._eval_metric_step = None
         if metric_specs:
             self._eval_metric_step = jax.jit(
                 eval_metric_step,
-                in_shardings=(self._pshard, dshd, eshd, label_shardings,
+                in_shardings=(pstore, dshd, eshd, label_shardings,
                               shd, rep),
                 out_shardings=rep)
 
@@ -1307,9 +1497,19 @@ class NetTrainer:
     # ------------------------------------------------------------------
     # checkpoint api
     # ------------------------------------------------------------------
+    def _full_params(self):
+        """Host params at FULL (stage-0) shapes: zero_stage=3 stores
+        shards between steps, so gather first (one all-gather per
+        weight) - checkpoints stay byte-compatible with stage 0 and
+        resume works across differing zero_stage."""
+        params = self.state["params"]
+        if getattr(self, "_zero_run", 0) == 3:
+            params = jax.jit(lambda t: t,
+                             out_shardings=self._pshard)(params)
+        return jax.tree.map(distributed.fetch_local, params)
+
     def save_model(self, fo) -> None:
-        params = jax.tree.map(distributed.fetch_local,
-                              self.state["params"])
+        params = self._full_params()
         if self.model_format == "cxxnet":
             # reference-binary export (nnet/legacy_format.py)
             from cxxnet_tpu.nnet import legacy_format
@@ -1319,7 +1519,7 @@ class NetTrainer:
         opt = None
         if self.save_optimizer:
             opt = self.state["ustate"]
-            if self.shard_optimizer:
+            if getattr(self, "_zero_run", 0) >= 1:
                 # re-replicate ZeRO-sharded state (one all-gather) so the
                 # host readback sees full tensors on every process
                 opt = jax.jit(lambda t: t,
@@ -1386,8 +1586,7 @@ class NetTrainer:
         else:
             from cxxnet_tpu.nnet import legacy_format
             blob = legacy_format.read_legacy_model(fi)
-        params = jax.tree.map(distributed.fetch_local,
-                              self.state["params"])
+        params = self._full_params()
         copied = []
         for lk, d in blob["params"].items():
             if lk.startswith("layer_"):
@@ -1417,7 +1616,13 @@ class NetTrainer:
         """Returns (2-D flattened weight, original shape); GetWeightVisitor
         flattening = (shape[0], prod(rest)) (visitor.h:26-100)."""
         lk = self._weight_key(layer_name, tag)
-        arr = distributed.fetch_local(self.state["params"][lk[0]][lk[1]])
+        leaf = self.state["params"][lk[0]][lk[1]]
+        if getattr(self, "_zero_run", 0) == 3:
+            # gather this weight's zero shards (visitors see full 2-D)
+            leaf = jax.jit(
+                lambda t: t,
+                out_shardings=self._pshard[lk[0]][lk[1]])(leaf)
+        arr = distributed.fetch_local(leaf)
         return arr.reshape(arr.shape[0], -1), arr.shape
 
     def set_weight(self, weight: np.ndarray, layer_name: str,
@@ -1428,9 +1633,10 @@ class NetTrainer:
         params = self.state["params"]
         # full global host value -> put_global_full (put_global would
         # misread it as a pre-cut local shard when the param is sharded
-        # across processes, e.g. tensor parallelism over hosts)
+        # across processes, e.g. tensor parallelism over hosts); lands
+        # on the between-steps layout (the zero cut under zero_stage=3)
         params[lk[0]][lk[1]] = distributed.put_global_full(
-            arr, self._pshard[lk[0]][lk[1]])
+            arr, self._params_store_shard[lk[0]][lk[1]])
         self.state["params"] = params
 
     def check_weights(self) -> List[str]:

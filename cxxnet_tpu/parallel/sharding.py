@@ -21,7 +21,7 @@ Rules (each layer declares which dim of each param rides 'model' via
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -32,6 +32,27 @@ MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+
+
+def shard_map_manual(fn, mesh: Mesh, manual_axes, in_specs, out_specs):
+    """shard_map across the old/new jax API split: manual over
+    `manual_axes`, every OTHER mesh axis left to GSPMD (auto), value
+    replication unchecked (the zero region's in/out specs assert the
+    layouts the trainer compiles against; a varying-axes check would
+    reject the deliberately-unreduced gradients). New API
+    (jax.shard_map: axis_names/check_vma) first, the 0.4.x
+    experimental spelling (auto/check_rep) as fallback."""
+    manual = set(manual_axes)
+    try:
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=manual,
+                             check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        auto = frozenset(a for a in mesh.axis_names
+                         if a not in manual)
+        return _sm(fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=False, auto=auto)
 
 
 def param_pspecs(net: Network, shapes=None) -> Dict[str, Dict[str, P]]:
@@ -75,9 +96,64 @@ def zero1_eligible_dim(spec, shape, dsize):
     return None
 
 
+def zero_partition_dims(
+        mesh: Mesh, net: Network,
+        pshard: Dict[str, Dict[str, NamedSharding]],
+        shapes=None,
+) -> Dict[str, Dict[str, Optional[int]]]:
+    """zero1_eligible_dim per parameter: the dim each ZeRO stage cuts
+    over 'data' (None = ineligible, the weight stays at its parameter
+    sharding). One tree drives all three stages so optimizer state
+    (stage 1), gradients/accumulator (stage 2) and parameters between
+    steps (stage 3) always agree on the cut. `shapes` (an init_params
+    eval_shape tree) may be passed to avoid re-tracing - the abstract
+    init trace scales with the model, and ZeRO targets big models."""
+    dsize = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        DATA_AXIS, 1)
+    if shapes is None:
+        shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
+    out: Dict[str, Dict[str, Optional[int]]] = {}
+    for lk, d in pshard.items():
+        out[lk] = {}
+        for pn, ns in d.items():
+            if dsize <= 1:
+                out[lk][pn] = None
+                continue
+            out[lk][pn] = zero1_eligible_dim(
+                ns.spec, shapes[lk][pn].shape, dsize)
+    return out
+
+
+def _zero_shard_tree(
+        mesh: Mesh, net: Network,
+        pshard: Dict[str, Dict[str, NamedSharding]],
+        shapes=None, dims=None,
+) -> Dict[str, Dict[str, NamedSharding]]:
+    """Parameter shardings with the eligible dim additionally riding
+    'data' (ineligible weights keep their parameter sharding)."""
+    if shapes is None:
+        shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
+    if dims is None:
+        dims = zero_partition_dims(mesh, net, pshard, shapes)
+    out: Dict[str, Dict[str, NamedSharding]] = {}
+    for lk, d in pshard.items():
+        out[lk] = {}
+        for pn, ns in d.items():
+            i = dims[lk][pn]
+            if i is None:
+                out[lk][pn] = ns
+                continue
+            shape = shapes[lk][pn].shape
+            spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
+            spec[i] = DATA_AXIS
+            out[lk][pn] = NamedSharding(mesh, P(*spec))
+    return out
+
+
 def zero1_shardings(
         mesh: Mesh, net: Network,
-        pshard: Dict[str, Dict[str, NamedSharding]]
+        pshard: Dict[str, Dict[str, NamedSharding]],
+        shapes=None, dims=None,
 ) -> Dict[str, Dict[str, NamedSharding]]:
     """ZeRO-1-style optimizer-state shardings: the update_on_server
     analog (nnet_ps_server.cpp:20-170 moves the updater to the server so
@@ -90,25 +166,66 @@ def zero1_shardings(
     rides 'data'. Weights with no such dim keep the parameter sharding
     (replication over data is always legal).
     """
-    dsize = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-        DATA_AXIS, 1)
-    shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
-    out: Dict[str, Dict[str, NamedSharding]] = {}
-    for lk, d in pshard.items():
-        out[lk] = {}
-        for pn, ns in d.items():
-            shape = shapes[lk][pn].shape
-            if dsize <= 1:
-                out[lk][pn] = ns
-                continue
-            i = zero1_eligible_dim(ns.spec, shape, dsize)
+    return _zero_shard_tree(mesh, net, pshard, shapes, dims)
+
+
+def zero2_shardings(
+        mesh: Mesh, net: Network,
+        pshard: Dict[str, Dict[str, NamedSharding]],
+        shapes=None, dims=None,
+) -> Dict[str, Dict[str, NamedSharding]]:
+    """ZeRO-2 gradient/accumulator shardings (arXiv:2004.13336 the rest
+    of the way): the same per-weight cut as the stage-1 optimizer state,
+    so the reduce-scattered gradient lands exactly on the shard its
+    updater state lives on and the update math needs no resharding. The
+    trainer stores the update_period>1 accumulator in this layout too
+    (peak gradient HBM / data-axis size between microsteps)."""
+    return _zero_shard_tree(mesh, net, pshard, shapes, dims)
+
+
+def zero3_shardings(
+        mesh: Mesh, net: Network,
+        pshard: Dict[str, Dict[str, NamedSharding]],
+        shapes=None, dims=None,
+) -> Dict[str, Dict[str, NamedSharding]]:
+    """ZeRO-3 parameter shardings BETWEEN steps: same cut again, now
+    applied to the weights themselves - each device keeps only its
+    shard and the forward all-gathers a weight just in time for its
+    layer (trainer's zero region). Checkpoints still store full
+    tensors (gather-on-save / reshard-on-load, nnet/checkpoint.py)."""
+    return _zero_shard_tree(mesh, net, pshard, shapes, dims)
+
+
+def zero_region_specs(
+        mesh: Mesh, net: Network,
+        pshard: Dict[str, Dict[str, NamedSharding]],
+        shapes=None, dims=None,
+) -> Tuple[Dict[str, Dict[str, P]], Dict[str, Dict[str, P]]]:
+    """(scatter_specs, gather_specs) for the trainer's manual-'data'
+    fwd/bwd region (shard_map with every other mesh axis auto): per
+    weight, the PartitionSpec naming ONLY the 'data' placement of its
+    zero cut. scatter_specs describe the psum_scatter'd gradient
+    outputs (and the stage-3 parameter inputs); gather_specs are P()
+    everywhere - the full-weight view the per-layer all_gather
+    restores (auto axes must not be named in manual specs, so the
+    tensor-parallel 'model' placement rides along via GSPMD)."""
+    if shapes is None:
+        shapes = jax.eval_shape(net.init_params, jax.random.PRNGKey(0))
+    if dims is None:
+        dims = zero_partition_dims(mesh, net, pshard, shapes)
+    scatter: Dict[str, Dict[str, P]] = {}
+    gather: Dict[str, Dict[str, P]] = {}
+    for lk, d in dims.items():
+        scatter[lk], gather[lk] = {}, {}
+        for pn, i in d.items():
+            gather[lk][pn] = P()
             if i is None:
-                out[lk][pn] = ns
+                scatter[lk][pn] = P()
                 continue
-            spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
+            spec = [None] * len(shapes[lk][pn].shape)
             spec[i] = DATA_AXIS
-            out[lk][pn] = NamedSharding(mesh, P(*spec))
-    return out
+            scatter[lk][pn] = P(*spec)
+    return scatter, gather
 
 
 def shardings_for(mesh: Mesh,

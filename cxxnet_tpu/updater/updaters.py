@@ -35,9 +35,25 @@ def _clip_nan(grad: jax.Array, bound: float) -> jax.Array:
 
 
 class Updater:
-    """Base per-tensor updater bound to an UpdaterParam."""
+    """Base per-tensor updater bound to an UpdaterParam.
+
+    Shard-shape contract (`zero_shardable`): under zero_stage >= 2 the
+    trainer calls `apply` with SHARD-shaped tensors - the weight,
+    gradient and every state leaf are one device's cut of the tensor
+    along the zero partition dim (parallel/sharding.py), and the
+    returned state/weight must be that same shard. An updater whose
+    math is elementwise over the tensor (all the shipped ones) is
+    shard-exact by construction: applying it per shard IS applying it
+    to the full tensor. An updater that reduces OVER the tensor (a
+    LARS/LAMB-style trust ratio from the global weight/grad norm) is
+    not - its per-shard application would use per-shard norms - and
+    must set `zero_shardable = False`; the trainer refuses to enable
+    stage 2/3 with it rather than silently training different math.
+    init_state must stay shape-polymorphic (zeros_like et al), so
+    shard-shaped weights produce shard-shaped state."""
 
     kind = ""
+    zero_shardable = True
 
     def __init__(self, param: UpdaterParam):
         self.param = param

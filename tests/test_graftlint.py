@@ -297,6 +297,95 @@ def read(cfg, blob):
 
 
 # ---------------------------------------------------------------------------
+# GL007 unsharded-large-intermediate
+# ---------------------------------------------------------------------------
+_GL007_HEADER = """
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+"""
+
+
+def test_gl007_direct_allocator_flags(tmp_path):
+    fs = _lint(tmp_path, _GL007_HEADER + """
+def step(params, grads):
+    accum = jnp.zeros_like(grads)
+    return accum
+
+step_fn = jax.jit(step)
+""")
+    assert _rules(fs) == ["GL007"]
+
+
+def test_gl007_tree_map_allocator_flags(tmp_path):
+    fs = _lint(tmp_path, _GL007_HEADER + """
+def step(state):
+    zero = jax.tree.map(jnp.zeros_like, state)
+    return zero
+
+step_fn = jax.jit(step)
+""")
+    assert _rules(fs) == ["GL007"]
+
+
+def test_gl007_sharding_constraint_on_statement_ok(tmp_path):
+    fs = _lint(tmp_path, _GL007_HEADER + """
+from jax import lax
+
+def step(params, shardings):
+    accum = lax.with_sharding_constraint(
+        jnp.zeros_like(params), shardings)
+    return accum
+
+step_fn = jax.jit(step)
+""")
+    assert _rules(fs) == []
+
+
+def test_gl007_mesh_less_module_not_flagged(tmp_path):
+    # no sharding machinery imported: nothing can replicate across
+    # devices, the allocation is just an allocation
+    fs = _lint(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+def step(params):
+    return jnp.zeros_like(params)
+
+step_fn = jax.jit(step)
+""")
+    assert _rules(fs) == []
+
+
+def test_gl007_unjitted_and_small_values_ok(tmp_path):
+    fs = _lint(tmp_path, _GL007_HEADER + """
+def host_init(params):
+    # not jit-traced: host-side init is not a per-step temporary
+    return jnp.zeros_like(params)
+
+def step(x):
+    y = jnp.zeros_like(x)   # 'x' is not weight-named
+    return y
+
+step_fn = jax.jit(step)
+""")
+    assert _rules(fs) == []
+
+
+def test_gl007_waivable(tmp_path):
+    fs = _lint(tmp_path, _GL007_HEADER + """
+def step(grads):
+    # graftlint: disable=GL007 zeros inherit the out_shardings layout
+    zero = jax.tree.map(jnp.zeros_like, grads)
+    return zero
+
+step_fn = jax.jit(step)
+""")
+    assert _rules(fs) == []
+    assert _rules(fs, waived=True) == ["GL007"]
+
+
+# ---------------------------------------------------------------------------
 # waivers
 # ---------------------------------------------------------------------------
 def test_waiver_same_line_and_standalone(tmp_path):
