@@ -929,6 +929,89 @@ def _bench_zero(ctx) -> dict:
         return {"zero2_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_serve(ctx) -> dict:
+    """Continuous-batching serving (serve/server.py, docs/SERVING.md):
+    warmed bucket executables + replica fan-out driven by a threaded
+    load generator of mixed-size requests. `serve_qps` is requests/s
+    and `serve_rows_per_s` images/s through the server (the physics-
+    capped field); `serve_p50_ms`/`serve_p99_ms` are the end-to-end
+    request latencies from the telemetry histogram; the derived
+    `serve_over_predict` prices continuous batching against the ideal
+    batch-at-a-time predict loop over the SAME images in the SAME
+    window (<1 = the bucket padding + admission wait you pay for
+    bounded per-request latency; docs/SERVING.md's cost model).
+    Queue depth rides the `serve.queue_depth` registry gauge.
+    Compiles one fwd executable per bucket. Disable with
+    CXN_BENCH_SERVE=0; CXN_BENCH_SERVE_MAXB bounds the bucket ladder
+    (default 32)."""
+    if os.environ.get("CXN_BENCH_SERVE") == "0":
+        return {}
+    try:
+        from cxxnet_tpu.io.data import DataBatch
+        from cxxnet_tpu.serve import Server
+        tr = ctx.trainer
+        batch = ctx.batch
+        rng = np.random.RandomState(17)
+        data, label = _alexnet_batch(rng, batch)
+        db = DataBatch(data, label)
+        # batch-at-a-time baseline over the same infer executable:
+        # compile + warm, one sizing rep, then a budgeted loop
+        tr.predict_dist(db)
+        t0 = time.perf_counter()
+        tr.predict_dist(db)
+        per_rep = max(time.perf_counter() - t0, 1e-6)
+        nrep = max(2, min(8, int(20.0 / per_rep)))
+        t0 = time.perf_counter()
+        for _ in range(nrep):
+            tr.predict_dist(db)
+        predict_rps = nrep * batch / (time.perf_counter() - t0)
+        mb = min(batch,
+                 int(os.environ.get("CXN_BENCH_SERVE_MAXB", "32")))
+        srv = Server(tr, max_batch=mb, max_wait_ms=2.0, replicas=2)
+        t0 = time.perf_counter()
+        srv.warmup()
+        warm_s = time.perf_counter() - t0
+        srv.start()
+        # mixed request sizes covering the bucket ladder; total rows
+        # sized to ~the baseline loop's traffic so both numbers come
+        # from comparable windows
+        sizes, total, i = [], 0, 0
+        cycle = [1, mb // 2, mb, 3, mb // 4 or 1, mb, 7, mb // 2]
+        target = max(2 * batch, nrep * batch // 2)
+        while total < target:
+            n = max(1, min(cycle[i % len(cycle)], mb))
+            sizes.append(n)
+            total += n
+            i += 1
+        reqs = [data[:n] for n in sizes]  # views: staging copies
+        t0 = time.perf_counter()
+        futs = [srv.submit(r) for r in reqs]
+        for f in futs:
+            f.result(timeout=600)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        stats = srv.stop()
+        if stats["errors"]:
+            return {"serve_error":
+                    f"{stats['errors']} dispatch errors"}
+        out = {
+            "serve_qps": round(len(reqs) / dt, 2),
+            "serve_rows_per_s": round(total / dt, 2),
+            "serve_p50_ms": stats["latency_p50_ms"],
+            "serve_p99_ms": stats["latency_p99_ms"],
+            "serve_warmup_s": round(warm_s, 2),
+            "serve_buckets": len(srv.buckets),
+            "serve_max_batch": mb,
+            "serve_requests": len(reqs),
+            "serve_padding_rows": stats["padding_rows"],
+        }
+        if predict_rps > 0:
+            out["serve_over_predict"] = round(
+                (total / dt) / predict_rps, 4)
+        return out
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"serve_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_pool_ties(make, batch, steps, platform: str) -> dict:
     """Compute-path throughput with `pool_grad = ties` (the reference's
     tie-duplicating max-pool backward) vs the bench flagship's
@@ -1101,6 +1184,7 @@ _MEASUREMENTS = (
     ("e2e_prefetch", _bench_prefetch, "CXN_BENCH_PREFETCH", 150, "h2d"),
     ("fused", _bench_fused, "CXN_BENCH_FUSED", 150, "h2d"),
     ("zero", _bench_zero, "CXN_BENCH_ZERO", 150, "h2d"),
+    ("serve", _bench_serve, "CXN_BENCH_SERVE", 150, "h2d"),
     ("attention",
      lambda c: _bench_attention(c.platform), "CXN_BENCH_ATTN", 100,
      "compute"),
@@ -1140,6 +1224,13 @@ _GFLOP_PER_IMG = {
     "e2e_prefetch_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_fused_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "zero2_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
+    # serving is forward-only (~1/3 of the fwd+dgrad+wgrad train
+    # cost); an UNDER-estimate only loosens the cap, never flags a
+    # real number. serve_qps is requests/s (>= 1 image each), so the
+    # per-image cap applied to it is conservative in the same
+    # direction; serve_rows_per_s carries the actual image rate
+    "serve_rows_per_s": ALEXNET_TRAIN_GFLOP_PER_IMG / 3.0,
+    "serve_qps": ALEXNET_TRAIN_GFLOP_PER_IMG / 3.0,
     "e2e_f32stage_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "device_augment_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_eval_train_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
@@ -1212,6 +1303,10 @@ def _derive(out: dict, batch: int, platform: str, ndev: int,
         out["zero_over_e2e"] = round(zero / e2e, 4)
     else:
         out.pop("zero_over_e2e", None)
+    if not out.get("serve_rows_per_s"):
+        # serve_over_predict is derived in-window by the serve child;
+        # it must not outlive a physics-retracted serve_rows_per_s
+        out.pop("serve_over_predict", None)
     if e2e:
         out["metric"] = "alexnet_b%d_%s_train_e2e" % (batch, platform)
         out["value"], out["value_is"] = e2e, "e2e"
@@ -1343,7 +1438,7 @@ _LAST_GOOD_PATH = os.path.join(_REPO, "docs", "last_good_tpu.json")
 # make them interpretable
 _LAST_GOOD_MAX_FIELDS = (
     "compute_ips", "e2e_ips", "e2e_devicedata_ips", "e2e_prefetch_ips",
-    "e2e_fused_ips", "zero2_ips",
+    "e2e_fused_ips", "zero2_ips", "serve_qps", "serve_rows_per_s",
     "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
     "resnet18_ips", "resnet18_devicedata_ips",
     "device_augment_ips", "chip_matmul_tflops", "attn_pallas_tflops",
@@ -1427,6 +1522,8 @@ _SYNC_SOURCE = {
     "e2e_prefetch_ips": "e2e_prefetch",
     "e2e_fused_ips": "fused",
     "zero2_ips": "zero",
+    "serve_qps": "serve", "serve_rows_per_s": "serve",
+    "serve_over_predict": "serve",
     "compute_poolties_ips": "pool_ties", "googlenet_ips": "googlenet",
     "googlenet_devicedata_ips": "googlenet",
     "resnet18_ips": "resnet18", "resnet18_devicedata_ips": "resnet18",

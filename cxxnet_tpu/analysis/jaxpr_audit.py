@@ -43,11 +43,22 @@ the jaxpr + StableHLO + compiled HLO:
   per-layer gathers). This closes the audit-coverage gap for the
   parallel executables the ROADMAP called out.
 
-Audited executables: `train_step`, `_train_chunk` (K=1 and K=4), and
-the eval pair (`eval_step`, `eval_metric_step`), over the tiny-MLP
+- **serve-audit**: the continuous-batching serving layer
+  (serve/server.py, docs/SERVING.md) audited at the executable level:
+  after warmup the inference executable's compiled-program count
+  equals the bucket count and stays FLAT over 100 mixed-size
+  requests (zero steady-state recompiles - the serving SLO depends
+  on it); each bucket executable is additionally put through the
+  artifact checks with donation asserted ABSENT (a donated param
+  would free the weights a concurrent replica still needs).
+
+Audited executables: `train_step`, `_train_chunk` (K=1 and K=4), the
+eval pair (`eval_step`, `eval_metric_step`) and the dedicated
+`infer_step` (predict/extract/serve share it), over the tiny-MLP
 config the fused-dispatch smoke uses, plus the zero-audit set
 (stage-2 `train_step`/`_train_chunk[K=4]` on `data:8`, stage-3
-`train_step` on `data:8`, stage-2 `train_step` on `data:4,model:2`).
+`train_step` on `data:8`, stage-2 `train_step` on `data:4,model:2`)
+and the serve bucket set.
 Run under `JAX_PLATFORMS=cpu` in CI; the checks are artifact-level,
 so they hold for any backend that compiles the same programs.
 """
@@ -378,6 +389,60 @@ def _cache_size(jitfn) -> Optional[int]:
     return fn() if callable(fn) else None
 
 
+# ---------------------------------------------------------------------------
+# serve audit: warmed bucket executables, zero steady-state recompiles
+# ---------------------------------------------------------------------------
+def _serve_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Build the continuous-batching server over a FRESH tiny trainer
+    (predict would pre-populate the shared infer cache and muddy the
+    bucket count) and assert the serving SLO's compile-time story:
+    bucket executables all compiled at warmup, none after."""
+    from cxxnet_tpu.serve import Server
+    tr = _make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=2)
+    if _cache_size(srv._fn) is None:
+        checks.append(_check(
+            "serve", "cache-size-api", False,
+            "jit._cache_size unavailable on this jax version"))
+        return {}
+    srv.warmup()
+    n_warm = _cache_size(srv._fn)
+    checks.append(_check(
+        "serve", "bucket-executables==bucket-count",
+        n_warm == len(srv.buckets),
+        f"cache={n_warm} buckets={list(srv.buckets)}"))
+    # 100 mixed-size requests over every bucket: the executable count
+    # must not move (steady-state serving performs zero recompiles)
+    srv.start()
+    rng = np.random.RandomState(7)
+    futs = [srv.submit(rng.rand(1 + int(rng.randint(8)), 1, 1, 36)
+                       .astype(np.float32))
+            for _ in range(100)]
+    for f in futs:
+        f.result(timeout=120)
+    stats = srv.stop()
+    n_after = _cache_size(srv._fn)
+    checks.append(_check(
+        "serve", "no-recompile-over-100-mixed-requests",
+        n_after == n_warm,
+        f"cache {n_warm} -> {n_after} after {stats['batches']} "
+        f"batches / {stats['rows']} rows"))
+    checks.append(_check(
+        "serve", "no-dispatch-errors", stats["errors"] == 0,
+        f"{stats['errors']} dispatch errors"))
+    # artifact checks per bucket executable - donation asserted ABSENT
+    # (a donated weight buffer would be freed under a concurrent
+    # replica's dispatch); run AFTER the flatness checks so .lower()
+    # cannot perturb the counted cache
+    for b in srv.buckets:
+        data = np.zeros((b, 1, 1, 36), np.float32)
+        gdata, gextras = tr.stage_infer_rows(data, ())
+        checks += _audit_executable(
+            f"serve[b={b}]", srv._fn,
+            (tr.state["params"], gdata, gextras), donated=False)
+    return {"serve_infer_warm": n_warm, "serve_infer_after": n_after}
+
+
 def _recompile_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
     tr = _make_trainer()
     if _cache_size(tr._train_step) is None:
@@ -425,13 +490,15 @@ def _recompile_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
         f"cache={sizes['train_step']} (padding must keep the "
         f"program shape static)"))
 
-    # eval executable: full + short batch, one program
+    # inference executable (the predict/extract/serve split): full +
+    # short batch pad to ONE program shape
     tr.predict(_batch(60))
     tr.predict(_batch(61, b=20))
-    sizes["eval_step"] = _cache_size(tr._eval_step)
+    nfin = tr.net_cfg.num_nodes - 1
+    sizes["infer_step"] = _cache_size(tr._infer_fn(nfin))
     checks.append(_check(
-        "recompile", "eval-cache==1 incl. padded short batch",
-        sizes["eval_step"] == 1, f"cache={sizes['eval_step']}"))
+        "recompile", "infer-cache==1 incl. padded short batch",
+        sizes["infer_step"] == 1, f"cache={sizes['infer_step']}"))
     return sizes
 
 
@@ -472,9 +539,16 @@ def run_audit() -> Dict[str, Any]:
             "eval_metric_step", tr._eval_metric_step,
             (tr.state["params"], sb.data, sb.extras, sb.labels,
              sb.mask, rng), donated=False)
+    # the dedicated inference executable (predict/extract/serve all
+    # share it - docs/SERVING.md); the serve audit below additionally
+    # covers its bucket-shaped instantiations
+    checks += _audit_executable(
+        "infer_step", tr._infer_fn(tr.net_cfg.num_nodes - 1),
+        (tr.state["params"], sb.data, sb.extras), donated=False)
 
     _zero_audit(checks)
     cache_sizes = _recompile_audit(checks)
+    cache_sizes.update(_serve_audit(checks))
     return {
         "platform": jax.default_backend(),
         "jax_version": jax.__version__,

@@ -4,9 +4,11 @@ Behavior parity with CXXNetLearnTask (src/cxxnet_main.cpp:16-478):
 
     python -m cxxnet_tpu.main <config.conf> [k=v ...]
 
-- tasks: train (default) / finetune / pred / pred_raw / extract
-  (pred_raw: raw top-node rows - the reference accepts the task name
-  but never dispatches it, cxxnet_main.cpp:77-79 vs :242)
+- tasks: train (default) / finetune / pred / pred_raw / extract /
+  serve (pred_raw: raw top-node rows - the reference accepts the task
+  name but never dispatches it, cxxnet_main.cpp:77-79 vs :242;
+  serve: the pred iterator replayed as a ragged request stream
+  through the continuous-batching server, docs/SERVING.md)
 - `continue = 1` resumes from the newest `model_dir/%04d.model`
 - per-round checkpoints gated by `save_model` period
 - eval metrics printed per round to stderr as
@@ -96,7 +98,16 @@ class LearnTask:
         # error with a did-you-mean suggestion instead of silently
         # configuring nothing; schema_check = 0 bypasses
         self.schema_check = 1
+        # task=serve load shape (docs/SERVING.md): rows per submitted
+        # request when replaying the pred iterator through the server
+        # (0 = a deterministic ragged size cycle, the bucket-coverage
+        # mode the serve-smoke CI job uses)
+        self.serve_rows = 1
         self.cfg: List[Tuple[str, str]] = []
+        # index of the first command-line override pair in self.cfg
+        # (None = everything is file-like); _split_blocks uses it to
+        # keep CLI pairs out of iterator-block scanning
+        self._n_file_pairs: Optional[int] = None
 
     # ------------------------------------------------------------------
     def run(self, argv: List[str]) -> int:
@@ -105,7 +116,7 @@ class LearnTask:
             return 0
         for name, val in parse_config_file(argv[0]):
             self.set_param(name, val)
-        n_file_pairs = len(self.cfg)
+        n_file_pairs = self._n_file_pairs = len(self.cfg)
         for arg in argv[1:]:
             if "=" in arg:
                 name, val = arg.split("=", 1)
@@ -166,6 +177,8 @@ class LearnTask:
                 self.task_predict_raw()
             elif self.task == "extract":
                 self.task_extract_feature()
+            elif self.task == "serve":
+                self.task_serve()
             else:
                 raise ValueError(f"unknown task {self.task}")
             return 0
@@ -232,6 +245,8 @@ class LearnTask:
             self.heartbeat_secs = float(val)
         if name == "schema_check":
             self.schema_check = int(val)
+        if name == "serve_rows":
+            self.serve_rows = int(val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -250,16 +265,29 @@ class LearnTask:
         cur: Optional[List[Tuple[str, str]]] = None
         evname = ""
         flag = 0
-        for name, val in self.cfg:
+        for idx, (name, val) in enumerate(self.cfg):
+            cli = (self._n_file_pairs is not None
+                   and idx >= self._n_file_pairs)
             if name == "data":
+                if cli:
+                    continue  # a CLI pair is never a block marker
                 flag, cur = 1, []
                 continue
             if name == "eval":
+                if cli:
+                    continue
                 flag, cur, evname = 2, [], val
                 continue
             if name == "pred":
-                flag, cur = 3, []
                 self.name_pred = val
+                if cli:
+                    # `pred=file.txt` on the command line renames the
+                    # output; opening an (unterminated) pred iterator
+                    # block here would silently swallow every override
+                    # after it - serve_max_batch=8 after pred= used to
+                    # configure nothing
+                    continue
+                flag, cur = 3, []
                 continue
             if name == "iter" and val == "end":
                 assert flag != 0, "wrong configuration file"
@@ -312,7 +340,7 @@ class LearnTask:
         erase the train block's crop)."""
         defcfg, train, evals, pred = self._split_blocks()
         feed = defcfg + (train or [])
-        if self.task in ("pred", "pred_raw", "extract"):
+        if self.task in ("pred", "pred_raw", "extract", "serve"):
             feed = feed + (pred or [])
         net = NetTrainer()
         for k, v in feed:
@@ -331,7 +359,7 @@ class LearnTask:
         what _create_net fed the trainer, so eff IS the compiled
         spec."""
         active = []
-        if self.task in ("pred", "pred_raw", "extract"):
+        if self.task in ("pred", "pred_raw", "extract", "serve"):
             if pred is not None:
                 active.append(("pred", pred))
         else:
@@ -549,7 +577,7 @@ class LearnTask:
     # ------------------------------------------------------------------
     def _create_iterators(self) -> None:
         defcfg, train, evals, pred = self._split_blocks()
-        if self.task in ("pred", "pred_raw", "extract"):
+        if self.task in ("pred", "pred_raw", "extract", "serve"):
             if pred is not None:
                 self.itr_pred = create_iterator(pred)
         else:
@@ -779,6 +807,97 @@ class LearnTask:
                     fo.write(" ".join(f"{v:g}" for v in row) + "\n")
         telemetry.stdout(
             f"finished prediction, write into {self.name_pred}")
+
+    def _serve_request_sizes(self):
+        """Row count of each submitted request (task=serve load
+        shape): serve_rows>0 = fixed; serve_rows=0 = a deterministic
+        ragged cycle 1,2,3,5,7,... capped at the largest bucket, so a
+        single pass exercises every bucket size (the serve-smoke CI
+        job's mode)."""
+        if self.serve_rows > 0:
+            while True:
+                yield self.serve_rows
+        cycle = [1, 2, 3, 5, 7, 4, 6, 8]
+        i = 0
+        while True:
+            yield cycle[i % len(cycle)]
+            i += 1
+
+    def task_serve(self) -> None:
+        """task=serve: the continuous-batching server (docs/SERVING.md)
+        warmed over its bucket executables, then the pred iterator
+        replayed as a request stream - the CLI's serving surface and
+        its own load generator. Output file matches task=pred line for
+        line (the parity the serve-smoke CI job asserts)."""
+        assert self.itr_pred is not None, \
+            "must specify a predict iterator to drive task = serve"
+        import numpy as np
+        from cxxnet_tpu.serve import Server, predictions_from_rows
+        srv = Server(self.net_trainer)
+        telemetry.stdout(
+            f"serve: warming {len(srv.buckets)} bucket executables "
+            f"{list(srv.buckets)}")
+        srv.warmup()
+        telemetry.stdout("serve: warmup done, start serving")
+        import collections
+        sizes = self._serve_request_sizes()
+        t0 = time.monotonic()
+        # bounded in-flight window: futures resolve in submission
+        # order, so results drain to the output file DURING iteration
+        # - task=pred streams in constant memory and task=serve must
+        # too (an unbounded submit-then-drain would hold the whole
+        # dataset's inputs and results in RAM)
+        futures = collections.deque()
+        max_inflight = 4 * srv.max_batch
+        srv.start()
+        try:
+            with atomic_writer(self.name_pred, "w") as fo:
+                def drain(down_to: int) -> None:
+                    while len(futures) > down_to:
+                        rows = futures.popleft().result()
+                        for v in predictions_from_rows(rows):
+                            fo.write(f"{v:g}\n")
+
+                self.itr_pred.before_first()
+                while self.itr_pred.next():
+                    batch = self.itr_pred.value()
+                    if batch.is_sparse():
+                        c, y, x = self.net_trainer.net_cfg.input_shape
+                        data = batch.to_dense(c * y * x).reshape(
+                            batch.batch_size, c, y, x)
+                    else:
+                        data = np.asarray(batch.data)
+                    valid = batch.batch_size - batch.num_batch_padd
+                    data = data[:valid]
+                    extras = [np.asarray(e)[:valid]
+                              for e in batch.extra_data[
+                                  :self.net_trainer.net_cfg
+                                  .extra_data_num]]
+                    lo = 0
+                    while lo < valid:
+                        n = min(next(sizes), valid - lo)
+                        futures.append(srv.submit(
+                            data[lo:lo + n],
+                            [e[lo:lo + n] for e in extras]))
+                        lo += n
+                        drain(max_inflight)
+                drain(0)
+        finally:
+            stats = srv.stop()
+        dt = time.monotonic() - t0
+        qps = stats["requests"] / dt if dt > 0 else 0.0
+        telemetry.stdout(
+            f"serve: {stats['requests']} requests ({stats['rows']} "
+            f"rows) in {dt:.2f} sec, {qps:.1f} req/s, "
+            f"p50 {stats['latency_p50_ms']} ms, "
+            f"p99 {stats['latency_p99_ms']} ms, "
+            f"{stats['padding_rows']} padding rows over "
+            f"{stats['batches']} batches")
+        telemetry.event("serve", op="summary", secs=dt, qps=qps, **{
+            k: v for k, v in stats.items() if not isinstance(v, dict)})
+        telemetry.emit_metrics(kind="serve")
+        telemetry.stdout(
+            f"finished serving, write into {self.name_pred}")
 
     def task_extract_feature(self) -> None:
         assert self.itr_pred is not None, \

@@ -164,6 +164,12 @@ class NetTrainer:
         # N batches so at most N batches of input buffers pin HBM
         # (0 = never sync - the whole eval set may stage ahead)
         self.eval_inflight = 8
+        # continuous-batching serving knobs (serve/server.py,
+        # docs/SERVING.md): largest request bucket (0 = batch_size),
+        # fill-or-timeout admission wait, and dispatcher replica count
+        self.serve_max_batch = 0
+        self.serve_max_wait_ms = 2.0
+        self.serve_replicas = 1
         self.profile = 0
         self.profile_dir = ""
         self.trace_round = 1
@@ -253,6 +259,18 @@ class NetTrainer:
             if int(val) < 0:
                 raise ValueError("eval_inflight must be >= 0")
             self.eval_inflight = int(val)
+        if name == "serve_max_batch":
+            if int(val) < 0:
+                raise ValueError("serve_max_batch must be >= 0")
+            self.serve_max_batch = int(val)
+        if name == "serve_max_wait_ms":
+            if float(val) < 0:
+                raise ValueError("serve_max_wait_ms must be >= 0")
+            self.serve_max_wait_ms = float(val)
+        if name == "serve_replicas":
+            if int(val) < 1:
+                raise ValueError("serve_replicas must be >= 1")
+            self.serve_replicas = int(val)
         if name == "profile":
             self.profile = int(val)
         if name == "profile_dir":
@@ -968,6 +986,37 @@ class NetTrainer:
         self._eval_step = jax.jit(
             eval_step, in_shardings=(pstore, dshd, eshd),
             out_shardings=shd)
+
+        # dedicated inference executable (docs/SERVING.md): donation-
+        # free, dropout-free, and - unlike eval_step, which returns
+        # EVERY node's value - computes only the requested node, so
+        # XLA dead-code-eliminates the rest and the host reads back
+        # one output tensor per batch instead of the whole node set
+        # (the wrapper predict path used to fetch every intermediate).
+        # Batch-size POLYMORPHIC: the first dim is whatever the caller
+        # stages, and jit caches one executable per distinct shape -
+        # the serving layer's per-bucket executables are exactly this
+        # cache (serve/server.py counts it to prove zero steady-state
+        # recompiles). One jit per requested node, built lazily;
+        # predict/extract/serve all share the cache.
+        def infer_step(node, params, data, extras):
+            outs = eval_step(params, data, extras)
+            return outs[node]
+
+        infer_jits: Dict[int, Any] = {}
+
+        def infer_fn(node: int):
+            fn = infer_jits.get(node)
+            if fn is None:
+                import functools
+                fn = jax.jit(
+                    functools.partial(infer_step, node),
+                    in_shardings=(pstore, dshd, eshd),
+                    out_shardings=shd)
+                infer_jits[node] = fn
+            return fn
+
+        self._infer_fn = infer_fn
         self._eval_metric_step = None
         if metric_specs:
             self._eval_metric_step = jax.jit(
@@ -1384,6 +1433,50 @@ class NetTrainer:
         return {nid: distributed.fetch_local(v)[:valid]
                 for nid, v in outs.items()}
 
+    def _infer_node(self, batch: DataBatch, node: int) -> np.ndarray:
+        """One node's output rows for a batch via the dedicated
+        inference executable (_compile's infer_fn): pad to the static
+        batch, stage, run, read back ONLY the requested node, trim the
+        padding rows. The predict/extract path - evaluate's metric-less
+        fallback keeps _forward_nodes (it needs several nodes from one
+        forward)."""
+        data, _, mask, extras = self._pad_batch(batch)
+        gdata = self._put_data(data)
+        shd = self._batch_sharded
+        gextras = tuple(distributed.put_global(e, shd) for e in extras)
+        out = self._infer_fn(node)(self.state["params"], gdata, gextras)
+        valid = int(mask.sum())
+        return distributed.fetch_local(out)[:valid]
+
+    def stage_infer_rows(self, data: np.ndarray, extras: Sequence = ()):
+        """Stage an ARBITRARY-row-count inference input under the infer
+        executable's in_shardings (the serving layer's bucket staging,
+        serve/server.py). Single-process serving only - the multi-
+        controller batch-row split of _put_data does not apply; the
+        row count must divide over the mesh's data axis (the Server's
+        bucket rule guarantees that)."""
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "stage_infer_rows is single-process (serving a "
+                "multi-controller mesh is not supported)")
+        gdata = jax.device_put(self._host_input(np.ascontiguousarray(data)),
+                               self._data_sharded)
+        shd = self._batch_sharded
+        gextras = tuple(
+            jax.device_put(np.ascontiguousarray(e, dtype=np.float32), shd)
+            for e in extras)
+        return gdata, gextras
+
+    def infer_rows(self, gdata, gextras=(), node: int = -1) -> jax.Array:
+        """Dispatch the inference executable on staged rows (the device
+        half of the serving hot path; stage_infer_rows is the host
+        half). node=-1 = the final node. Returns the device array -
+        the caller decides when to read back."""
+        if node < 0:
+            node = self.net_cfg.num_nodes - 1
+        return self._infer_fn(node)(self.state["params"], gdata,
+                                    tuple(gextras))
+
     # graftlint: hot-path
     def evaluate(self, data_iter, data_name: str) -> str:
         """Run eval metrics over an iterator; returns the reference-format
@@ -1472,9 +1565,9 @@ class NetTrainer:
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """Prediction = argmax of the final node (or raw scalar);
-        nnet_impl-inl.hpp:186-199 TransformPred."""
-        nodes = self._forward_nodes(batch)
-        out = nodes[self.net_cfg.num_nodes - 1]
+        nnet_impl-inl.hpp:186-199 TransformPred. Runs the dedicated
+        inference executable (single-node readback, docs/SERVING.md)."""
+        out = self._infer_node(batch, self.net_cfg.num_nodes - 1)
         flat = out.reshape(out.shape[0], -1)
         if flat.shape[1] == 1:
             return flat[:, 0]
@@ -1482,8 +1575,7 @@ class NetTrainer:
 
     def predict_dist(self, batch: DataBatch) -> np.ndarray:
         """Full output distribution of the final node."""
-        nodes = self._forward_nodes(batch)
-        out = nodes[self.net_cfg.num_nodes - 1]
+        out = self._infer_node(batch, self.net_cfg.num_nodes - 1)
         return out.reshape(out.shape[0], -1)
 
     def extract_feature(self, batch: DataBatch,
@@ -1491,8 +1583,7 @@ class NetTrainer:
         """Copy out any node by name or `top[-k]`
         (nnet_impl-inl.hpp:200-223)."""
         nid = self.net.node_index(node_name)
-        nodes = self._forward_nodes(batch)
-        return nodes[nid]
+        return self._infer_node(batch, nid)
 
     # ------------------------------------------------------------------
     # checkpoint api

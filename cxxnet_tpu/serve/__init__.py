@@ -1,0 +1,6 @@
+"""Continuous-batching inference serving layer (docs/SERVING.md)."""
+
+from cxxnet_tpu.serve.server import (
+    Server, bucket_sizes, predictions_from_rows)
+
+__all__ = ["Server", "bucket_sizes", "predictions_from_rows"]

@@ -1,0 +1,443 @@
+"""Continuous-batching inference server (docs/SERVING.md).
+
+The predict/extract tasks are batch-at-a-time, train-shaped code: one
+caller, one fixed batch, one padded dispatch. Production serving is
+the opposite shape - many concurrent callers submitting a few rows
+each - and the TF-paper framing (PAPERS.md, arXiv:1605.08695) treats
+it as the same dataflow system with a different driver. This module is
+that driver:
+
+- a **shared request queue**: `submit()` is thread-safe and returns a
+  future; requests larger than the biggest bucket split internally and
+  re-join on `result()`;
+- **continuous/dynamic batching into padded buckets**: dispatchers
+  coalesce queued requests up to `max_batch` rows and run the smallest
+  power-of-two bucket that covers them, padding the tail. Every bucket
+  size is a distinct program shape of ONE jitted inference executable
+  (trainer's `infer_fn`), so the bucket set compiles once;
+- **warmed executables**: `warmup()` runs every bucket once at
+  startup. Steady state then performs ZERO recompiles - provable via
+  the same `_cache_size` technique the jaxpr audit uses
+  (`executable_cache_size()` == `len(buckets)` and stays flat);
+- **replica fan-out**: `replicas` dispatcher threads drain the shared
+  queue; each dispatch is the SPMD executable over the full mesh (on
+  `mesh = data:N` the bucket's rows spread over the data axis), and
+  jax's async dispatch lets replicas pipeline host staging against
+  device compute. `zero_stage = 3` params are consumed directly at
+  their stored (sharded) layout - the executable's in_shardings are
+  the trainer's `pstore`, so no host-side gather ever runs;
+- an **admission/flush policy**: a dispatcher waits up to
+  `max_wait_ms` for the bucket to fill, then flushes what it has
+  (fill-or-timeout), so p99 latency stays bounded under low load.
+
+Telemetry (docs/OBSERVABILITY.md): `serve.latency_s` histogram
+(p50/p99 through the registry), `serve.queue_depth` gauge,
+`serve.requests`/`serve.rows`/`serve.batches`/`serve.padding_rows`/
+`serve.errors` counters. These accumulate unconditionally (they are
+the product surface, queried via `Server.stats()`), like the fault
+counters - no per-row device sync is added beyond the result readback
+serving inherently requires.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cxxnet_tpu import telemetry
+
+
+def bucket_sizes(max_batch: int, data_axis: int = 1) -> Tuple[int, ...]:
+    """The padded-batch bucket set: powers of two up to `max_batch`
+    that the mesh's data axis divides (a bucket's rows must split
+    evenly over the axis), plus `max_batch` itself. At least one
+    bucket must exist - a `max_batch` the data axis does not divide
+    cannot be dispatched and is rejected here, at configure time."""
+    if max_batch < 1:
+        raise ValueError("serve_max_batch must be >= 1")
+    if max_batch % max(data_axis, 1):
+        raise ValueError(
+            f"serve_max_batch={max_batch} must be a multiple of the "
+            f"mesh's data-axis size ({data_axis}) - every bucket "
+            "dispatches over that axis")
+    out = set()
+    b = 1
+    while b <= max_batch:
+        if b % data_axis == 0:
+            out.add(b)
+        b *= 2
+    out.add(max_batch)
+    return tuple(sorted(out))
+
+
+def predictions_from_rows(rows: np.ndarray) -> np.ndarray:
+    """The TransformPred rule (trainer.predict) applied to raw final-
+    node rows: single-column output passes through as scalars, wider
+    output argmaxes - so a serve result file is comparable line-for-
+    line with a `task = pred` file."""
+    rows = np.asarray(rows)
+    flat = rows.reshape(rows.shape[0], -1)
+    if flat.shape[1] == 1:
+        return flat[:, 0]
+    return np.argmax(flat, axis=1).astype(np.float32)
+
+
+class _Future:
+    """Minimal one-shot result future (no concurrent.futures executor
+    to tie its lifetime to)."""
+
+    __slots__ = ("_ev", "_value", "_error")
+
+    def __init__(self) -> None:
+        self._ev = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def _set(self, value) -> None:
+        self._value = value
+        self._ev.set()
+
+    def _set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("serve request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _JoinedFuture:
+    """A request that split into several work items: result() is the
+    row-concatenation of the parts, in submission order."""
+
+    __slots__ = ("_parts",)
+
+    def __init__(self, parts: List[_Future]) -> None:
+        self._parts = parts
+
+    def done(self) -> bool:
+        return all(p.done() for p in self._parts)
+
+    def result(self, timeout: Optional[float] = None):
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        out = []
+        for p in self._parts:
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            out.append(p.result(left))
+        return np.concatenate(out, axis=0)
+
+
+class _WorkItem:
+    __slots__ = ("data", "extras", "n", "t_submit", "future")
+
+    def __init__(self, data, extras, t_submit) -> None:
+        self.data = data
+        self.extras = extras
+        self.n = data.shape[0]
+        self.t_submit = t_submit
+        self.future = _Future()
+
+
+class Server:
+    """Continuous-batching server over a trainer's inference
+    executable. The trainer must hold a model (init_model or
+    load_model); its mesh, dtype and device_augment spec all apply
+    unchanged - serving is the same compiled forward predict runs,
+    driven by a queue instead of an iterator.
+
+    start() spawns the dispatcher replicas (warmup() first unless you
+    want the first requests to pay the compiles); submit() from any
+    thread; stop() drains the queue, joins the replicas and returns
+    stats(). Usable as a context manager."""
+
+    def __init__(self, trainer, max_batch: int = 0,
+                 max_wait_ms: Optional[float] = None,
+                 replicas: Optional[int] = None,
+                 node: int = -1) -> None:
+        import jax
+        if trainer.state is None:
+            raise RuntimeError(
+                "Server needs an initialized trainer (init_model or "
+                "load_model first)")
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "serving a multi-controller job is not supported; run "
+                "the server on a single-process mesh")
+        self.trainer = trainer
+        self.max_batch = int(max_batch or trainer.serve_max_batch
+                             or trainer.batch_size)
+        self.max_wait_ms = float(
+            trainer.serve_max_wait_ms if max_wait_ms is None
+            else max_wait_ms)
+        self.replicas = int(trainer.serve_replicas if replicas is None
+                            else replicas)
+        if self.replicas < 1:
+            raise ValueError("serve_replicas must be >= 1")
+        self.node = (node if node >= 0
+                     else trainer.net_cfg.num_nodes - 1)
+        dsize = trainer.mesh.shape.get("data", 1)
+        self.buckets = bucket_sizes(self.max_batch, dsize)
+        self._fn = trainer._infer_fn(self.node)
+        c, y, x = trainer.net_cfg.input_shape
+        self._input_dims = (c, y, x)
+        self._extra_dims = [
+            tuple(trainer.net.node_shapes[1 + i][1:])
+            for i in range(trainer.net_cfg.extra_data_num)]
+        self._queue: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._queued_rows = 0
+        self._threads: List[threading.Thread] = []
+        self._draining = False
+        self._started = False
+        self.warmup_s = 0.0
+        # product-surface accounting, independent of the process-wide
+        # registry (a second Server in one process must not inherit
+        # the first one's counts OR its latency window); the registry
+        # mirrors everything for the metrics stream/report
+        self._lock = threading.Lock()
+        self._n_requests = 0
+        self._n_rows = 0
+        self._n_batches = 0
+        self._n_padding = 0
+        self._n_errors = 0
+        self._bucket_hits: Dict[int, int] = {b: 0 for b in self.buckets}
+        self._lat = telemetry.Histogram()
+
+    # -- lifecycle ---------------------------------------------------------
+    def warmup(self) -> float:
+        """Compile + run every bucket executable once (zeros input) so
+        steady-state serving never compiles. Returns the wall seconds
+        spent; also recorded as `serve.warmup_s`."""
+        import jax
+        t0 = time.perf_counter()
+        params = self.trainer.state["params"]
+        for b in self.buckets:
+            data = np.zeros((b,) + self._input_dims, np.float32)
+            extras = [np.zeros((b,) + d, np.float32)
+                      for d in self._extra_dims]
+            gdata, gextras = self.trainer.stage_infer_rows(data, extras)
+            jax.block_until_ready(self._fn(params, gdata, gextras))
+        self.warmup_s = time.perf_counter() - t0
+        telemetry.observe("serve.warmup_s", self.warmup_s)
+        telemetry.event("serve", op="warmup", buckets=list(self.buckets),
+                        secs=self.warmup_s)
+        return self.warmup_s
+
+    def executable_cache_size(self) -> Optional[int]:
+        """Compiled-program count of the inference executable (the
+        jaxpr audit's `_cache_size` technique): after warmup this
+        equals len(buckets) and must stay flat under any steady-state
+        request mix - the zero-recompile proof."""
+        fn = getattr(self._fn, "_cache_size", None)
+        return fn() if callable(fn) else None
+
+    def start(self) -> "Server":
+        if self._started:
+            return self
+        self._draining = False
+        self._started = True
+        for i in range(self.replicas):
+            t = threading.Thread(target=self._replica_loop,
+                                 name=f"serve-replica-{i}", daemon=True)
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def stop(self, drain: bool = True) -> Dict[str, Any]:
+        """Stop the replicas - after draining the queue (default), or
+        immediately failing queued requests (drain=False) - and return
+        stats(). Idempotent."""
+        with self._cond:
+            self._draining = True
+            if not drain:
+                while self._queue:
+                    it = self._queue.popleft()
+                    self._queued_rows -= it.n
+                    it.future._set_error(
+                        RuntimeError("server stopped before dispatch"))
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=60.0)
+        self._threads = []
+        self._started = False
+        telemetry.set_gauge("serve.queue_depth", 0.0)
+        stats = self.stats()
+        telemetry.event("serve", op="stop", **{
+            k: v for k, v in stats.items() if not isinstance(v, dict)})
+        return stats
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- submission --------------------------------------------------------
+    def submit(self, data: np.ndarray, extras: Sequence = ()):
+        """Enqueue one request: data is (n, c, y, x) rows or a single
+        (c, y, x) instance; extras (if the net declares extra inputs)
+        ride along row-aligned. Returns a future whose result() is the
+        raw final-node rows, (n, width) - predictions_from_rows turns
+        them into predict()-style labels. Thread-safe; requests wider
+        than the largest bucket split transparently."""
+        if not self._started:
+            raise RuntimeError("Server not started (call start())")
+        data = np.ascontiguousarray(data)
+        if data.ndim == 3:
+            data = data[None]
+        if data.ndim != 4 or data.shape[1:] != self._input_dims:
+            raise ValueError(
+                f"serve request must be (n, {self._input_dims[0]}, "
+                f"{self._input_dims[1]}, {self._input_dims[2]}) or a "
+                f"single instance; got {data.shape}")
+        if data.shape[0] < 1:
+            raise ValueError("serve request needs at least one row")
+        extras = [np.ascontiguousarray(e, dtype=np.float32)
+                  for e in extras]
+        if len(extras) != len(self._extra_dims):
+            raise ValueError(
+                f"net declares {len(self._extra_dims)} extra inputs "
+                f"but the request carries {len(extras)}")
+        for e in extras:
+            if e.shape[0] != data.shape[0]:
+                raise ValueError("extras must be row-aligned with data")
+        t_submit = time.monotonic()
+        items = []
+        for lo in range(0, data.shape[0], self.max_batch):
+            hi = lo + self.max_batch
+            items.append(_WorkItem(
+                data[lo:hi], [e[lo:hi] for e in extras], t_submit))
+        with self._cond:
+            if self._draining:
+                raise RuntimeError("server is stopping")
+            for it in items:
+                self._queue.append(it)
+                self._queued_rows += it.n
+            depth = self._queued_rows
+            self._cond.notify_all()
+        with self._lock:
+            self._n_requests += 1
+            self._n_rows += data.shape[0]
+        telemetry.inc("serve.requests")
+        telemetry.inc("serve.rows", data.shape[0])
+        telemetry.set_gauge("serve.queue_depth", depth)
+        if len(items) == 1:
+            return items[0].future
+        return _JoinedFuture([it.future for it in items])
+
+    # -- dispatchers -------------------------------------------------------
+    def _collect(self) -> Optional[List[_WorkItem]]:
+        """Admission policy: block for work, then coalesce queued
+        items up to max_batch rows, waiting at most max_wait_ms past
+        the FIRST item's submit time for the batch to fill
+        (fill-or-timeout). Returns None when stopping and drained."""
+        with self._cond:
+            while not self._queue:
+                if self._draining:
+                    return None
+                self._cond.wait(0.05)
+            first = self._queue.popleft()
+            items = [first]
+            total = first.n
+            deadline = first.t_submit + self.max_wait_ms / 1e3
+            while total < self.max_batch:
+                if self._queue:
+                    if self._queue[0].n <= self.max_batch - total:
+                        it = self._queue.popleft()
+                        items.append(it)
+                        total += it.n
+                        continue
+                    break  # head doesn't fit: ship what we have
+                wait = deadline - time.monotonic()
+                if wait <= 0 or self._draining:
+                    break
+                self._cond.wait(min(wait, 0.05))
+            self._queued_rows -= total
+            telemetry.set_gauge("serve.queue_depth", self._queued_rows)
+            return items
+
+    def _run_batch(self, items: List[_WorkItem]) -> None:
+        from cxxnet_tpu.parallel import distributed
+        total = sum(it.n for it in items)
+        bucket = next(b for b in self.buckets if b >= total)
+        data = np.concatenate([it.data for it in items], axis=0)
+        extras = [
+            np.concatenate([it.extras[i] for it in items], axis=0)
+            for i in range(len(self._extra_dims))]
+        if bucket > total:
+            pad = bucket - total
+            data = np.concatenate(
+                [data, np.zeros((pad,) + data.shape[1:], data.dtype)],
+                axis=0)
+            extras = [np.concatenate(
+                [e, np.zeros((pad,) + e.shape[1:], e.dtype)], axis=0)
+                for e in extras]
+        gdata, gextras = self.trainer.stage_infer_rows(data, extras)
+        out = self._fn(self.trainer.state["params"], gdata, gextras)
+        rows = distributed.fetch_local(out)
+        rows = rows.reshape(bucket, -1)
+        t_done = time.monotonic()
+        off = 0
+        for it in items:
+            it.future._set(rows[off:off + it.n])
+            off += it.n
+            self._lat.observe(t_done - it.t_submit)
+            telemetry.observe("serve.latency_s", t_done - it.t_submit)
+        with self._lock:
+            self._n_batches += 1
+            self._n_padding += bucket - total
+            self._bucket_hits[bucket] += 1
+        telemetry.inc("serve.batches")
+        telemetry.inc("serve.padding_rows", bucket - total)
+
+    def _replica_loop(self) -> None:
+        while True:
+            items = self._collect()
+            if items is None:
+                return
+            try:
+                self._run_batch(items)
+            except BaseException as e:  # noqa: BLE001 - delivered via futures
+                with self._lock:
+                    self._n_errors += 1
+                telemetry.inc("serve.errors")
+                telemetry.stderr(
+                    f"serve: dispatch failed: {type(e).__name__}: {e}\n",
+                    event_kind="serve", op="error",
+                    error=f"{type(e).__name__}: {e}")
+                for it in items:
+                    if not it.future.done():
+                        it.future._set_error(e)
+
+    # -- reporting ---------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Product-surface summary: request/row/batch/padding counts,
+        per-bucket dispatch counts, and latency p50/p99 (ms) from the
+        registry histogram."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "requests": self._n_requests,
+                "rows": self._n_rows,
+                "batches": self._n_batches,
+                "padding_rows": self._n_padding,
+                "errors": self._n_errors,
+                "buckets": {b: n for b, n in self._bucket_hits.items()},
+            }
+        out["warmup_s"] = round(self.warmup_s, 4)
+        for q, key in ((50, "latency_p50_ms"), (99, "latency_p99_ms")):
+            v = self._lat.percentile(q)
+            out[key] = round(v * 1e3, 3) if v == v else None
+        return out

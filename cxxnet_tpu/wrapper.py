@@ -135,6 +135,49 @@ class Net:
         return self._net.extract_feature(_batch_from_numpy(data, None),
                                          node_name)
 
+    # -- serving (docs/SERVING.md) -------------------------------------
+    def serve_start(self, max_batch: int = 0,
+                    max_wait_ms: Optional[float] = None,
+                    replicas: Optional[int] = None) -> None:
+        """Start the continuous-batching server over this net's
+        inference executable: bucket executables compiled + warmed
+        here, dispatcher replicas spawned. Unset arguments fall back
+        to the net's serve_* config keys (serve_max_batch /
+        serve_max_wait_ms / serve_replicas)."""
+        if getattr(self, "_server", None) is not None:
+            raise RuntimeError("server already started")
+        from cxxnet_tpu.serve import Server
+        srv = Server(self._net, max_batch=max_batch,
+                     max_wait_ms=max_wait_ms, replicas=replicas)
+        # attach only once running: a warmup failure (compile error,
+        # OOM) must leave serve_start retryable, not wedge the Net
+        # behind "server already started"
+        srv.warmup()
+        srv.start()
+        self._server = srv
+
+    def serve_submit(self, data: np.ndarray,
+                     block: bool = True):
+        """Submit numpy rows ((n, c, y, x) or one (c, y, x) instance)
+        to the running server. block=True (default) returns the raw
+        final-node rows, (n, width) - the predict_dist surface;
+        block=False returns a future whose result() yields them
+        (concurrent submitters are what continuous batching
+        coalesces). cxxnet_tpu.serve.predictions_from_rows converts
+        rows to predict()-style labels."""
+        if getattr(self, "_server", None) is None:
+            raise RuntimeError("call serve_start first")
+        fut = self._server.submit(np.asarray(data, dtype=np.float32))
+        return fut.result() if block else fut
+
+    def serve_stop(self) -> dict:
+        """Drain + stop the server; returns its stats() summary
+        (request/batch/padding counts, latency p50/p99 ms)."""
+        if getattr(self, "_server", None) is None:
+            raise RuntimeError("no server running")
+        srv, self._server = self._server, None
+        return srv.stop()
+
     def has_layer(self, layer_name: str) -> bool:
         return layer_name in self._net.net_cfg.layer_name_map
 

@@ -33,6 +33,13 @@ def test_bench_run_end_to_end(monkeypatch, tmp_path):
     assert out["unit"] == "images/sec"
     # the eval_train variant exercises the metric-compiled step
     assert out["e2e_eval_train_ips"] > 0
+    # the continuous-batching serving family (docs/SERVING.md):
+    # qps + latency percentiles + the vs-batch-predict ratio
+    assert out["serve_qps"] > 0
+    assert out["serve_rows_per_s"] > 0
+    assert out["serve_p99_ms"] is not None
+    assert out["serve_over_predict"] > 0
+    assert out["serve_buckets"] >= 1
     # the input-split extra runs on CPU too
     assert out["host_prep_ms_p50"] > 0
     assert out["device_step_ms_p50"] > 0

@@ -43,7 +43,7 @@ def test_eval_executables_do_not_donate(audit, target):
 
 @pytest.mark.parametrize("target", [
     "train_step", "train_chunk[K=1]", "train_chunk[K=4]",
-    "eval_step", "eval_metric_step"])
+    "eval_step", "eval_metric_step", "infer_step"])
 def test_no_f64_no_callbacks_no_consts(audit, target):
     assert _by(audit, target, "no-f64")["ok"]
     assert _by(audit, target, "no-host-callback")["ok"]
@@ -53,9 +53,24 @@ def test_no_f64_no_callbacks_no_consts(audit, target):
 def test_recompile_counts(audit):
     """A 4+4+1 round costs exactly 2 chunk executables (K=4 + the
     short-chunk K=1), stays 2 on round 2, and padded short batches
-    add no step/eval programs."""
+    add no step/infer programs."""
     sizes = audit["cache_sizes"]
     assert sizes["train_chunk_round1"] == 2
     assert sizes["train_chunk_round2"] == 2
     assert sizes["train_step"] == 1
-    assert sizes["eval_step"] == 1
+    assert sizes["infer_step"] == 1
+
+
+def test_serve_bucket_executables(audit):
+    """Serving warmup compiles exactly one executable per bucket and
+    100 mixed-size requests add none (the zero-steady-state-recompile
+    SLO); serve executables never donate (a freed weight buffer under
+    a concurrent replica would be a use-after-free)."""
+    assert _by(audit, "serve", "bucket-executables==bucket-count")["ok"]
+    assert _by(audit, "serve",
+               "no-recompile-over-100-mixed-requests")["ok"]
+    sizes = audit["cache_sizes"]
+    assert sizes["serve_infer_warm"] == sizes["serve_infer_after"] == 4
+    for b in (1, 2, 4, 8):
+        assert _by(audit, f"serve[b={b}]", "no-spurious-donation")["ok"]
+        assert _by(audit, f"serve[b={b}]", "no-host-callback")["ok"]
