@@ -1815,6 +1815,7 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
             _derive(out, batch, platform, ndev, peak_tflops)
             _snapshot(out)
     _measure_graftlint(out)
+    _measure_obs(out)
     _snapshot(out)
     _finalize(out, platform)
     return out
@@ -1837,6 +1838,55 @@ def _measure_graftlint(out: dict) -> None:
         out["graftlint_budget_s"] = 10.0
     except Exception as e:  # noqa: BLE001 - extras must not kill bench
         out["graftlint_error"] = f"{type(e).__name__}: {e}"
+
+
+def _measure_obs(out: dict) -> None:
+    """Cost of the live observability plane's exposition path
+    (docs/OBSERVABILITY.md): Prometheus render time over a
+    realistically populated registry, and one localhost /metrics
+    scrape round trip through the stdlib HTTP server - the per-scrape
+    tax a metrics_port= run pays, which must stay far below any sane
+    scrape interval. Guarded like every extra."""
+    try:
+        from cxxnet_tpu import telemetry
+        from cxxnet_tpu.telemetry.http import (
+            ObservabilityServer, render_prometheus, validate_exposition)
+        tel = telemetry.Telemetry()
+        # ~the instrument population of a long training run: a few
+        # dozen series incl. full histogram windows
+        for i in range(24):
+            h = tel.histogram(f"bench.h{i:02d}_s")
+            for k in range(512):
+                h.observe((k % 97) * 1e-4)
+        for i in range(24):
+            tel.inc(f"bench.c{i:02d}", i * 7)
+            tel.set_gauge(f"bench.g{i:02d}", i * 0.5)
+        t0 = time.monotonic()
+        n_render = 20
+        for _ in range(n_render):
+            text = render_prometheus(tel)
+        out["obs_render_ms"] = round(
+            (time.monotonic() - t0) / n_render * 1e3, 3)
+        if validate_exposition(text):
+            out["obs_error"] = "render produced malformed exposition"
+            return
+        import urllib.request
+        srv = ObservabilityServer(tel, 0, host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            scrapes = []
+            for _ in range(10):
+                t0 = time.monotonic()
+                with urllib.request.urlopen(url, timeout=5.0) as r:
+                    r.read()
+                scrapes.append(time.monotonic() - t0)
+            scrapes.sort()
+            out["obs_scrape_ms"] = round(
+                scrapes[len(scrapes) // 2] * 1e3, 3)
+        finally:
+            srv.close()
+    except Exception as e:  # noqa: BLE001 - extras must not kill bench
+        out["obs_error"] = f"{type(e).__name__}: {e}"
 
 
 def _finalize(out: dict, platform: str) -> None:

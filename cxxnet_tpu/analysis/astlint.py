@@ -28,6 +28,13 @@ Rules (stable ids - the waiver/CI contract; docs/STATIC_ANALYSIS.md):
   ``.get`` on a cfg-like dict whose key the config schema registry
   (schema.py) does not recognize - a typo'd key silently reads the
   default forever.
+- **GL008 metric-name-style**: a string-literal metric/beacon name
+  passed to a telemetry instrument call (``telemetry.inc`` /
+  ``set_gauge`` / ``observe`` / ``span`` / ``counter`` / ``gauge`` /
+  ``histogram`` / ``beacon``) that does not match the dotted-lowercase
+  grammar ``[a-z0-9_]+(\\.[a-z0-9_]+)+`` - the registry creates
+  instruments on first use, so a typo'd or off-grammar name silently
+  opens a PARALLEL series every dashboard and alert rule misses.
 - **GL007 unsharded-large-intermediate**: a jit-traced function in a
   mesh-aware module (one importing Mesh/NamedSharding/PartitionSpec
   or the parallel package) allocates a weight-tree-sized temporary -
@@ -78,6 +85,7 @@ RULES: Dict[str, str] = {
     "GL005": "donated-arg-reuse",
     "GL006": "unknown-config-key",
     "GL007": "unsharded-large-intermediate",
+    "GL008": "metric-name-style",
     "GL090": "bad-waiver",
     "GL091": "unused-waiver",
 }
@@ -800,6 +808,73 @@ def _rule_unsharded_intermediate(ctx: _FileCtx, fn: ast.AST) -> None:
 
 
 # ---------------------------------------------------------------------------
+# GL008 metric-name-style (module-wide, like GL004)
+# ---------------------------------------------------------------------------
+# the dotted-lowercase metric naming grammar (docs/OBSERVABILITY.md):
+# at least two [a-z0-9_]+ segments joined by dots
+_METRIC_NAME_RE = re.compile(r"[a-z0-9_]+(\.[a-z0-9_]+)+")
+# span() names nest into "outer/inner" registry paths at runtime (the
+# API's documented idiom uses short segment names like "round" /
+# "step"), so a span segment may be a SINGLE lowercase token - the
+# style bugs (uppercase, spaces, dashes) still flag
+_SPAN_NAME_RE = re.compile(r"[a-z0-9_]+(\.[a-z0-9_]+)*")
+# telemetry calls whose first string argument IS a series name
+_METRIC_CALLS = frozenset({
+    "inc", "set_gauge", "observe", "span", "counter", "gauge",
+    "histogram", "beacon",
+})
+
+
+def _tel_name(name: str) -> bool:
+    """Exact telemetry-identifier match: `telemetry`, `tel`, `_tel`,
+    `_TEL`, `self._tel`, `my_tel` - NOT substring hits like `hotel`
+    or `intel` (a substring rule would fail CI on unrelated APIs)."""
+    low = name.lower()
+    return (low.lstrip("_") in ("tel", "telemetry")
+            or low.endswith(("_tel", "_telemetry")))
+
+
+def _tel_receiver(expr: ast.expr) -> bool:
+    """Is this call receiver telemetry-flavored? Covers the repo's
+    idioms - `telemetry.inc`, `tel.observe`, `self._tel.span`,
+    `telemetry.get().inc` - without dragging unrelated `.observe()`
+    APIs into the rule."""
+    if isinstance(expr, ast.Name):
+        return _tel_name(expr.id)
+    if isinstance(expr, ast.Attribute):
+        return _tel_name(expr.attr) or _tel_receiver(expr.value)
+    if isinstance(expr, ast.Call):
+        return _tel_receiver(expr.func)
+    return False
+
+
+def _rule_metric_names(ctx: _FileCtx) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_CALLS
+                and _tel_receiver(func.value)):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue  # dynamic names are the caller's responsibility
+        name = node.args[0].value
+        rx = (_SPAN_NAME_RE if func.attr == "span"
+              else _METRIC_NAME_RE)
+        if not rx.fullmatch(name):
+            what = ("span segment" if func.attr == "span"
+                    else "metric name")
+            ctx.emit(
+                "GL008", node,
+                f"{what} {name!r} in telemetry.{func.attr}() does "
+                f"not match the dotted-lowercase naming grammar - "
+                f"off-grammar names silently create parallel series "
+                f"no dashboard or alert rule watches")
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 def _function_visits(ctx: _FileCtx) -> None:
@@ -844,6 +919,7 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     _scan_comments(ctx, source)
     _module_pass(ctx)
     _rule_wallclock(ctx)
+    _rule_metric_names(ctx)
     _function_visits(ctx)
     _apply_waivers(ctx)
     ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))

@@ -91,6 +91,15 @@ class LearnTask:
         self.metrics_file = ""
         self.log_format = "json"
         self.heartbeat_secs = 0.0
+        # live observability plane (docs/OBSERVABILITY.md): /metrics +
+        # /healthz + /varz HTTP exposition, declarative alert rules,
+        # hang watchdog. All off by default - unarmed runs never
+        # import the plane, keeping CLI output byte-identical
+        self.metrics_port = 0
+        self.metrics_host = ""
+        self.alert_rules = ""
+        self.alert_cmd = ""
+        self.watchdog_secs = 0.0
         self.device = "tpu"
         self.eval_train = 1
         self.test_on_server = 0
@@ -157,6 +166,17 @@ class LearnTask:
             log_format=self.log_format,
             heartbeat_secs=self.heartbeat_secs,
             tags={"device": self.device})
+        # live observability plane (docs/OBSERVABILITY.md): watchdog,
+        # alert rules, /metrics-/healthz-/varz HTTP exposition. With
+        # all four keys unset this is a no-op that imports nothing;
+        # metrics_port=0 means OFF on the CLI (an ephemeral bind is a
+        # programmatic-only mode - an operator could never find it)
+        telemetry.arm_observability(
+            metrics_port=(self.metrics_port if self.metrics_port > 0
+                          else None),
+            metrics_host=self.metrics_host,
+            alert_rules=self.alert_rules, alert_cmd=self.alert_cmd,
+            watchdog_secs=self.watchdog_secs)
         telemetry.event("run_start", task=self.task, conf=argv[0],
                         num_round=self.num_round)
         t_run = time.monotonic()
@@ -243,6 +263,16 @@ class LearnTask:
             self.log_format = val
         if name == "heartbeat_secs":
             self.heartbeat_secs = float(val)
+        if name == "metrics_port":
+            self.metrics_port = int(val)
+        if name == "metrics_host":
+            self.metrics_host = val
+        if name == "alert_rules":
+            self.alert_rules = val
+        if name == "alert_cmd":
+            self.alert_cmd = val
+        if name == "watchdog_secs":
+            self.watchdog_secs = float(val)
         if name == "schema_check":
             self.schema_check = int(val)
         if name == "serve_rows":
@@ -539,6 +569,9 @@ class LearnTask:
         secs = time.perf_counter() - t0
         telemetry.inc("checkpoint.saves")
         telemetry.observe("checkpoint.save_s", secs)
+        # progress beacon: a round spent fsyncing a huge checkpoint is
+        # slow, not hung - the watchdog must not page on it
+        telemetry.beacon("checkpoint.save")
         try:
             nbytes = os.path.getsize(path)
         except OSError:

@@ -1273,6 +1273,11 @@ class NetTrainer:
         self.epoch = self._epoch_base + (
             (self._step_counter - self._skipped_steps)
             // self.update_period)
+        # progress beacon for the hang watchdog / absence alert rules
+        # (docs/OBSERVABILITY.md): one dict store, no device sync -
+        # the step DISPATCHED; a hung backend blocks above, in the
+        # step call or the guard readback, and the beacon goes stale
+        telemetry.beacon("train.step")
         if track:
             # per-step timing forces a device sync (same cost profile=1
             # always paid; staging prefetch still overlaps on its
@@ -1346,6 +1351,9 @@ class NetTrainer:
         self.epoch = self._epoch_base + (
             (self._step_counter - self._skipped_steps)
             // self.update_period)
+        # K dispatched microsteps of progress (same beacon the
+        # streamed path marks - the watchdog is dispatch-mode-blind)
+        telemetry.beacon("train.step", k)
         if track:
             # graftlint: disable=GL002 honest per-chunk timing requires the sync - profile/telemetry_steps opt-in only
             jax.block_until_ready(self.state["epoch"])
@@ -1507,6 +1515,9 @@ class NetTrainer:
                      for k, v in labels.items()},
                     distributed.put_global(mask.astype(np.float32), shd),
                     rng))
+                # eval progress beacon: round-boundary evals can
+                # dwarf watchdog_secs without being a hang
+                telemetry.beacon("eval.step")
                 if self.eval_inflight and step % self.eval_inflight == 0:
                     # bound in-flight work: without a periodic sync the
                     # host loop stages the whole dataset's input
@@ -1539,6 +1550,7 @@ class NetTrainer:
                 p = nodes[nid][:nvalid]
                 preds.append(p.reshape(p.shape[0], -1))
             self.metric.add_eval(preds, labels)
+            telemetry.beacon("eval.step")
         return self.metric.print(data_name)
 
     def eval_train_metric(self) -> str:

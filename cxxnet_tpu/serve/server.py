@@ -165,7 +165,9 @@ class Server:
     def __init__(self, trainer, max_batch: int = 0,
                  max_wait_ms: Optional[float] = None,
                  replicas: Optional[int] = None,
-                 node: int = -1) -> None:
+                 node: int = -1,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "0.0.0.0") -> None:
         import jax
         if trainer.state is None:
             raise RuntimeError(
@@ -195,6 +197,14 @@ class Server:
         self._extra_dims = [
             tuple(trainer.net.node_shapes[1 + i][1:])
             for i in range(trainer.net_cfg.extra_data_num)]
+        # attachable live-exposition server (docs/OBSERVABILITY.md):
+        # metrics_port=N serves /metrics + /healthz + /varz for the
+        # Server's lifetime (0 = ephemeral bind, read .metrics_server
+        # .port). None = off; programmatic twins of the CLI key, which
+        # arms the process-wide plane in main.run instead
+        self.metrics_port = metrics_port
+        self.metrics_host = metrics_host
+        self.metrics_server = None
         self._queue: collections.deque = collections.deque()
         self._cond = threading.Condition()
         self._queued_rows = 0
@@ -246,6 +256,15 @@ class Server:
     def start(self) -> "Server":
         if self._started:
             return self
+        if self.metrics_port is not None and self.metrics_server is None:
+            from cxxnet_tpu.telemetry.http import ObservabilityServer
+            self.metrics_server = ObservabilityServer(
+                telemetry.get(), int(self.metrics_port),
+                host=self.metrics_host)
+            self.metrics_server.start()
+            telemetry.event("observability", op="http_start",
+                            port=self.metrics_server.port,
+                            host=self.metrics_host)
         self._draining = False
         self._started = True
         for i in range(self.replicas):
@@ -272,6 +291,9 @@ class Server:
             t.join(timeout=60.0)
         self._threads = []
         self._started = False
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
         telemetry.set_gauge("serve.queue_depth", 0.0)
         stats = self.stats()
         telemetry.event("serve", op="stop", **{
@@ -402,6 +424,9 @@ class Server:
             self._bucket_hits[bucket] += 1
         telemetry.inc("serve.batches")
         telemetry.inc("serve.padding_rows", bucket - total)
+        # serving progress beacon: a wedged dispatch (hung backend)
+        # stops marking and the watchdog dumps the stuck replica stack
+        telemetry.beacon("serve.batch")
 
     def _replica_loop(self) -> None:
         while True:

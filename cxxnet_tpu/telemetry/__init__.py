@@ -29,25 +29,32 @@ main.py; the full schema is docs/OBSERVABILITY.md.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import socket
 import sys
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
+from cxxnet_tpu.telemetry.health import HealthState
 from cxxnet_tpu.telemetry.registry import (
     Counter, Gauge, Histogram, MetricsRegistry)
 from cxxnet_tpu.telemetry.sink import LineSink, read_jsonl
 
 __all__ = [
     "Telemetry", "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "LineSink", "read_jsonl", "get", "configure", "close", "enabled",
-    "metrics_enabled", "counter", "gauge", "histogram", "inc",
-    "set_gauge", "observe", "span", "event", "emit_metrics", "stdout",
-    "stderr", "set_tags", "reset_for_tests",
+    "HealthState", "LineSink", "read_jsonl", "get", "configure",
+    "close", "enabled", "metrics_enabled", "counter", "gauge",
+    "histogram", "inc", "set_gauge", "observe", "span", "event",
+    "emit_metrics", "stdout", "stderr", "set_tags", "beacon",
+    "beacons", "recent_spans", "arm_observability",
+    "disarm_observability", "health", "reset_for_tests",
 ]
+
+# completed spans kept for the watchdog's stall dump ("what ran last")
+RECENT_SPANS = 64
 
 
 class _NullSpan:
@@ -92,6 +99,7 @@ class _Span:
         if stack:
             stack.pop()
         self._tel.observe(self._path, secs)
+        # event() also records the span into the recent-span ring
         self._tel.event("span", name=self._path, secs=secs,
                         **self._fields)
         return False
@@ -104,12 +112,34 @@ class Telemetry:
 
     def __init__(self) -> None:
         self.registry = MetricsRegistry()
+        self.health = HealthState()
         self._log: Optional[LineSink] = None
         self._metrics: Optional[LineSink] = None
         self.heartbeat_secs = 0.0
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        # test hook: a fake-clock wait fn (signature of Event.wait)
+        # injected by the heartbeat-hardening tests; None = real clock
+        self._hb_waiter = None
+        # `final` snapshot emitted: the heartbeat must never write a
+        # trailing snapshot after it (the stream's terminal record);
+        # the flag is checked-and-written under _emit_lock
+        self._finalized = False
+        self._emit_lock = threading.Lock()
         self._local = threading.local()
+        # progress beacons (watchdog.py / absence alert rules):
+        # name -> (count, monotonic ts of the newest mark); locked -
+        # serve replicas mark the same beacon concurrently and an
+        # unlocked read-modify-write would drop counts
+        self._beacon_lock = threading.Lock()
+        self._beacons: Dict[str, Tuple[int, float]] = {}
+        self._recent_spans: collections.deque = collections.deque(
+            maxlen=RECENT_SPANS)
+        # live observability plane handles (armed via
+        # arm_observability; None = the zero-overhead default)
+        self._http = None
+        self._alerts = None
+        self._watchdog = None
         self._tags: Dict[str, object] = {
             "host": socket.gethostname(),
             "pid": os.getpid(),
@@ -136,6 +166,7 @@ class Telemetry:
                          if metrics_file else None)
         if tags:
             self._tags.update(tags)
+        self._finalized = False
         self.heartbeat_secs = float(heartbeat_secs or 0.0)
         if self.heartbeat_secs > 0 and (self._log or self._metrics):
             self._start_heartbeat()
@@ -145,9 +176,93 @@ class Telemetry:
         is known after distributed init)."""
         self._tags.update(tags)
 
+    def tags(self) -> Dict[str, object]:
+        return dict(self._tags)
+
+    # -- progress beacons --------------------------------------------------
+    def beacon(self, name: str, n: int = 1) -> None:
+        """Mark progress (one dict store + a monotonic read - no
+        device sync, safe on every step). The watchdog and absence
+        alert rules judge liveness by beacon age; the instrumented
+        sites are train.step / eval.step / serve.batch /
+        checkpoint.save."""
+        with self._beacon_lock:
+            prev = self._beacons.get(name)
+            self._beacons[name] = (
+                (prev[0] if prev else 0) + n, time.monotonic())
+
+    def beacons(self) -> Dict[str, Tuple[int, float]]:
+        """{name: (count, monotonic ts of newest mark)} snapshot."""
+        with self._beacon_lock:
+            return dict(self._beacons)
+
+    def recent_spans(self):
+        """Newest-last list of recently completed spans
+        ({ts, name, secs}) - the watchdog's "what ran last" evidence."""
+        return list(self._recent_spans)
+
+    # -- live observability plane ------------------------------------------
+    def arm_observability(self, metrics_port: Optional[int] = None,
+                          alert_rules: str = "", alert_cmd: str = "",
+                          watchdog_secs: float = 0.0,
+                          metrics_host: str = ""):
+        """Bring up the live plane: the hang watchdog
+        (``watchdog_secs>0``), the alert engine (``alert_rules`` file,
+        optional ``alert_cmd`` shell hook) and the HTTP exposition
+        server (``metrics_port`` - 0 binds an ephemeral port; None =
+        no server). With every knob off this returns without
+        importing anything: no thread, no socket, no import-time side
+        effects - the byte-parity contract's disabled path.
+
+        Returns the ObservabilityServer (or None), whose ``.port`` is
+        the resolved bind."""
+        if (metrics_port is None and not alert_rules
+                and not (watchdog_secs and watchdog_secs > 0)):
+            return None
+        self.disarm_observability()
+        if watchdog_secs and watchdog_secs > 0:
+            from cxxnet_tpu.telemetry.watchdog import Watchdog
+            self._watchdog = Watchdog(self, float(watchdog_secs))
+            self._watchdog.start()
+        if alert_rules:
+            from cxxnet_tpu.telemetry.alerts import (
+                AlertEngine, load_rules)
+            self._alerts = AlertEngine(self, load_rules(alert_rules),
+                                       alert_cmd=alert_cmd)
+            self._alerts.start()
+        if metrics_port is not None:
+            from cxxnet_tpu.telemetry.http import ObservabilityServer
+            # default bind is all interfaces (cross-host scraping is
+            # the point); metrics_host=127.0.0.1 restricts to
+            # loopback - the endpoints are unauthenticated, see the
+            # exposure note in docs/OBSERVABILITY.md
+            self._http = ObservabilityServer(
+                self, int(metrics_port),
+                host=metrics_host or "0.0.0.0")
+            self._http.start()
+            self.event("observability", op="http_start",
+                       port=self._http.port, host=self._http.host)
+        return self._http
+
+    def disarm_observability(self) -> None:
+        """Stop watchdog/alerts/http (reverse arm order: detectors
+        first so a final scrape cannot observe a half-closed plane).
+        Idempotent; firing detectors clear their health sources."""
+        if self._watchdog is not None:
+            self._watchdog.close()
+            self._watchdog = None
+        if self._alerts is not None:
+            self._alerts.close()
+            self._alerts = None
+        if self._http is not None:
+            self._http.close()
+            self._http = None
+
     def close(self) -> None:
-        """Flush + close sinks and stop the heartbeat; the registry
-        keeps accumulating (counters outlive any one sink's life)."""
+        """Tear down the observability plane (watchdog/alerts/http),
+        flush + close sinks and stop the heartbeat; the registry keeps
+        accumulating (counters outlive any one sink's life)."""
+        self.disarm_observability()
         self._stop_heartbeat()
         if self._log is not None:
             self._log.close()
@@ -158,8 +273,18 @@ class Telemetry:
 
     @property
     def enabled(self) -> bool:
-        """True when ANY sink is armed (events or metrics stream)."""
-        return self._log is not None or self._metrics is not None
+        """True when a consumer of the FULL instrumentation is armed:
+        a JSONL sink, or the /metrics HTTP server (a scraper wants the
+        per-step histograms - arming metrics_port opts into the same
+        per-step device-sync cost a metrics_file does;
+        telemetry_steps=0 still opts back out). Deliberately NOT the
+        watchdog or alert engine alone: forensics and counter/beacon
+        rules must not silently serialize async dispatch with
+        per-step syncs - the diagnostic would perturb the thing it
+        diagnoses. Rules over train.* step histograms need a sink or
+        metrics_port armed too (docs/OBSERVABILITY.md)."""
+        return (self._log is not None or self._metrics is not None
+                or self._http is not None)
 
     @property
     def metrics_enabled(self) -> bool:
@@ -206,7 +331,17 @@ class Telemetry:
         return rec
 
     def event(self, kind: str, **fields) -> None:
-        """Emit a structured event to the event log (no-op unarmed)."""
+        """Emit a structured event to the event log (no-op unarmed).
+        ``span`` events also feed the recent-span ring: the trainer
+        emits its per-step/per-chunk span records directly as events
+        (not via span() contexts), and the watchdog's stall dump
+        wants exactly those as its "what ran last" evidence."""
+        if kind == "span" and "name" in fields:
+            # graftlint: disable=GL004 ring keeps wall TIMESTAMPS like the streams
+            ts = time.time()
+            self._recent_spans.append(
+                {"ts": ts, "name": fields["name"],
+                 "secs": round(float(fields.get("secs") or 0.0), 6)})
         log = self._log
         if log is not None:
             log.write(self._record(kind, fields))
@@ -214,12 +349,29 @@ class Telemetry:
     def emit_metrics(self, kind: str = "metrics", **fields) -> None:
         """Emit a full registry snapshot record to the metrics stream
         (no-op when metrics_file is unarmed). Extra fields ride on the
-        record - per-round emitters attach round/step/throughput."""
+        record - per-round emitters attach round/step/throughput.
+        ``kind="final"`` marks the stream terminal: a heartbeat racing
+        the shutdown must not append a trailing snapshot after it."""
         sink = self._metrics
-        if sink is not None:
+        if sink is None:
+            return
+        # check-and-write under one lock: a heartbeat that passed an
+        # unlocked check could be descheduled, lose the race to the
+        # `final` write, and still append after the terminal record
+        with self._emit_lock:
+            if kind == "final":
+                self._finalized = True
+            elif kind == "heartbeat" and self._finalized:
+                return
             fields = dict(fields)
             fields["metrics"] = self.registry.snapshot()
             sink.write(self._record(kind, fields))
+
+    def snapshot_record(self, kind: str = "varz") -> Dict[str, object]:
+        """One metrics-stream-schema record ({ts, tags..., kind,
+        metrics}) without writing it anywhere - the `/varz` body, so
+        live scrapes and file tails parse identically."""
+        return self._record(kind, {"metrics": self.registry.snapshot()})
 
     def flush(self) -> None:
         if self._log is not None:
@@ -261,9 +413,18 @@ class Telemetry:
         # fresh event and loop forever as a duplicate-emitting zombie
         stop = self._hb_stop = threading.Event()
         interval = self.heartbeat_secs
+        # test hook: a fake clock replaces the Event.wait sleep so the
+        # hardening contract (prompt close(), no post-`final` beat) is
+        # pinned without real time
+        waiter = self._hb_waiter or stop.wait
 
         def run():
-            while not stop.wait(interval):
+            while not waiter(interval):
+                # re-check AFTER waking: a tick that raced close() or
+                # the terminal `final` snapshot must emit nothing -
+                # close() returns with the stream already terminal
+                if stop.is_set() or self._finalized:
+                    return
                 with contextlib.suppress(Exception):
                     # a dying heartbeat must never take training down
                     self.emit_metrics(kind="heartbeat")
@@ -357,11 +518,41 @@ def set_tags(**tags) -> None:
     _TEL.set_tags(**tags)
 
 
+def beacon(name: str, n: int = 1) -> None:
+    _TEL.beacon(name, n)
+
+
+def beacons() -> Dict[str, Tuple[int, float]]:
+    return _TEL.beacons()
+
+
+def recent_spans():
+    return _TEL.recent_spans()
+
+
+def arm_observability(**kwargs):
+    return _TEL.arm_observability(**kwargs)
+
+
+def disarm_observability() -> None:
+    _TEL.disarm_observability()
+
+
+def health() -> HealthState:
+    return _TEL.health
+
+
 def reset_for_tests() -> None:
-    """Close sinks, wipe the registry, and restore default tags -
+    """Close sinks + the observability plane, wipe the registry,
+    beacons, span ring and health state, and restore default tags -
     test isolation only (configure()/set_tags mutate the process-wide
     tag dict, which must not leak across tests)."""
     _TEL.close()
     _TEL.registry.reset()
+    _TEL.health.reset()
+    _TEL._beacons = {}
+    _TEL._recent_spans.clear()
+    _TEL._finalized = False
+    _TEL._hb_waiter = None
     _TEL._tags = {"host": socket.gethostname(), "pid": os.getpid(),
                   "proc": 0}

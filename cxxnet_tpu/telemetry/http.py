@@ -1,0 +1,210 @@
+"""HTTP exposition: `/metrics` (Prometheus), `/healthz`, `/varz`.
+
+The registry and JSONL streams (registry.py / sink.py) are complete
+but *offline* - nothing could watch a live run without tailing files.
+This module is the live side: a stdlib-only background HTTP server
+(the repo's first real network transport - a stepping stone for the
+serving-transport roadmap item) exposing
+
+- ``/metrics``: Prometheus text exposition (version 0.0.4) of the
+  full registry - counters as ``cxxnet_<name>_total``, gauges as
+  ``cxxnet_<name>``, histograms as summaries with ``quantile="0.5"``
+  / ``quantile="0.99"`` series plus ``_sum``/``_count`` (the same
+  count/sum/p50/p99 the JSONL snapshots carry). Dots become
+  underscores; a process-tag info metric (``cxxnet_process_info``)
+  carries the {host, pid, proc, device} tags as escaped labels so a
+  multi-host scrape stays attributable.
+- ``/healthz``: 200 while the process is healthy, 503 with the
+  reasons JSON once the watchdog or an alert rule flags it
+  (health.py); scrape-friendly liveness for load balancers and the
+  obs-smoke CI job.
+- ``/varz``: one JSON object, byte-compatible with a metrics-stream
+  record (``{ts, host, pid, proc, ..., kind: "varz", metrics: {...}}``)
+  so ``tools/agg.py`` can scrape live processes and file tails with
+  the same parser.
+
+Armed only by ``metrics_port=`` (or ``Server(metrics_port=...)``);
+with the key unset this module is never imported - the CLI
+byte-parity contract costs nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from cxxnet_tpu.telemetry.registry import Counter, Gauge, Histogram
+from cxxnet_tpu.telemetry.sink import _sanitize
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Prometheus metric-name alphabet; everything else becomes "_"
+_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "cxxnet_"
+
+
+def prom_name(name: str) -> str:
+    """Registry name -> Prometheus name: dotted-lowercase grammar
+    (GL008) maps onto the prom alphabet by replacing dots; anything
+    foreign is flattened to underscores and a leading digit is
+    shielded (prom names must not start with one)."""
+    out = _BAD_CHARS.sub("_", name.replace(".", "_"))
+    if out and out[0].isdigit():
+        out = "_" + out
+    return _PREFIX + out
+
+
+def prom_label_escape(v: object) -> str:
+    """Label-value escaping per the text exposition spec: backslash,
+    double quote and newline."""
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_value(v) -> str:
+    """One sample value: prom accepts NaN/+Inf/-Inf tokens (which the
+    JSONL sinks must NOT emit - different consumers, different
+    specs)."""
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(tel) -> str:
+    """The full registry as Prometheus text exposition, sorted by
+    name so consecutive scrapes diff cleanly."""
+    lines: List[str] = []
+    tags = tel.tags()
+    labels = ",".join(f'{k}="{prom_label_escape(v)}"'
+                      for k, v in sorted(tags.items()))
+    lines.append("# TYPE cxxnet_process_info gauge")
+    lines.append("cxxnet_process_info{%s} 1" % labels)
+    for name, inst in sorted(tel.registry.instruments().items()):
+        pname = prom_name(name)
+        if isinstance(inst, Counter):
+            lines.append(f"# TYPE {pname}_total counter")
+            lines.append(f"{pname}_total {_fmt_value(inst.value)}")
+        elif isinstance(inst, Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {_fmt_value(inst.value)}")
+        elif isinstance(inst, Histogram):
+            snap = inst.snapshot()
+            lines.append(f"# TYPE {pname} summary")
+            lines.append(f'{pname}{{quantile="0.5"}} '
+                         f'{_fmt_value(snap["p50"])}')
+            lines.append(f'{pname}{{quantile="0.99"}} '
+                         f'{_fmt_value(snap["p99"])}')
+            lines.append(f"{pname}_sum {_fmt_value(snap['sum'])}")
+            lines.append(f"{pname}_count {_fmt_value(snap['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+# one exposition line: comment, or `name[{labels}] value` where value
+# is a float or a NaN/+Inf/-Inf token (promtool's line grammar, the
+# check the obs-smoke job and the tests run over real scrapes)
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r" (NaN|[+-]Inf|[+-]?[0-9]*\.?[0-9]+([eE][+-]?[0-9]+)?)$")
+_COMMENT_RE = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Promtool-style line check of a `/metrics` body; returns the
+    list of malformed lines (empty = valid)."""
+    bad = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if not _COMMENT_RE.match(line):
+                bad.append(line)
+        elif not _SAMPLE_RE.match(line):
+            bad.append(line)
+    return bad
+
+
+def _make_handler(tel):
+    class _Handler(BaseHTTPRequestHandler):
+        # one scrape per GET; no keep-alive state worth protocol 1.1
+        protocol_version = "HTTP/1.0"
+
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/metrics":
+                    self._send(200, render_prometheus(tel).encode(),
+                               PROM_CONTENT_TYPE)
+                elif path == "/varz":
+                    rec = tel.snapshot_record(kind="varz")
+                    self._send(200, json.dumps(
+                        _sanitize(rec), separators=(",", ":"),
+                        default=str).encode(), "application/json")
+                elif path in ("/healthz", "/health"):
+                    ok, reasons = tel.health.status()
+                    body = json.dumps(
+                        {"ok": ok, "reasons": reasons}).encode()
+                    self._send(200 if ok else 503, body,
+                               "application/json")
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # scraper went away mid-write; nothing to save
+
+        def log_message(self, *args) -> None:
+            # BaseHTTPRequestHandler logs every request to stderr by
+            # default - scrape traffic must never touch the CLI's
+            # stderr (byte-parity applies to the ARMED run's normal
+            # lines too; scrapes are not run output)
+            pass
+
+    return _Handler
+
+
+class ObservabilityServer:
+    """Background exposition server. Binds at construction (so the
+    resolved port - meaningful with port=0 ephemeral binds in tests -
+    is immediately readable), serves on a daemon thread after
+    ``start()``, and ``close()`` shuts the socket down and joins."""
+
+    def __init__(self, tel, port: int = 0, host: str = "0.0.0.0"):
+        self._srv = ThreadingHTTPServer((host, int(port)),
+                                        _make_handler(tel))
+        self._srv.daemon_threads = True
+        self.port: int = self._srv.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ObservabilityServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._srv.serve_forever,
+                name="telemetry-http", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._srv.shutdown()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._srv.server_close()

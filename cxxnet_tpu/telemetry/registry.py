@@ -179,6 +179,13 @@ class MetricsRegistry:
         with self._lock:
             return self._instruments.get(name)
 
+    def instruments(self) -> Dict[str, Instrument]:
+        """Copied name -> instrument map (the Prometheus exposition
+        needs instrument KINDS, which snapshot() erases - a counter
+        and an integer-valued gauge snapshot identically)."""
+        with self._lock:
+            return dict(self._instruments)
+
     def reset(self) -> None:
         with self._lock:
             self._instruments = {}

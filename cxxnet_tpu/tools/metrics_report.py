@@ -1,22 +1,28 @@
-"""Summarize a telemetry metrics JSONL into per-round tables.
+"""Summarize telemetry metrics JSONL stream(s) into per-round tables.
 
     python -m cxxnet_tpu.tools.metrics_report metrics.jsonl
+    python -m cxxnet_tpu.tools.metrics_report host0.jsonl host1.jsonl
     python -m cxxnet_tpu.tools.metrics_report metrics.jsonl --json
 
 Input is the ``metrics_file=`` stream a training run emits
 (docs/OBSERVABILITY.md): per-round ``round`` records carrying step/data
 timing stats plus a full registry snapshot, and a terminal ``final``
-snapshot. Output is a per-round throughput/latency table, per-round
-deltas of the interesting counters (checkpoint saves, retries, NaN
-rollbacks), and a final-counter summary. ``--json`` renders the same
-aggregation as one JSON object for scripting.
+snapshot. Several files - a pod run's per-host streams - merge on
+their ``ts`` + process tags (every record carries host/pid/proc), so
+no manual ``cat | sort`` is needed and the per-process counter deltas
+stay correct across the interleave. Output is a per-round
+throughput/latency table (with a proc column once more than one
+process appears), per-round deltas of the interesting counters
+(checkpoint saves, retries, NaN rollbacks), and a final-counter
+summary per process. ``--json`` renders the same aggregation as one
+JSON object for scripting.
 """
 
 from __future__ import annotations
 
 import json
 import sys
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Union
 
 from cxxnet_tpu.telemetry.sink import read_jsonl
 
@@ -42,12 +48,31 @@ def _hist_stat(metrics: Dict, name: str, stat: str) -> Optional[float]:
     return None
 
 
-def aggregate(path: str) -> Dict:
-    """Parse one metrics JSONL into {rounds: [...], finals: {...}}.
+def _read_merged(paths: Sequence[str]) -> List[Dict]:
+    """All records of all streams, merged on ts (stable: same-ts
+    records keep their file order). Per-host pod streams each carry a
+    monotone-nondecreasing ts, so a plain sort IS the timeline merge;
+    the proc tags on each record keep per-process accounting apart
+    downstream."""
+    recs: List[Dict] = []
+    for p in paths:
+        recs.extend(read_jsonl(p))
+    recs.sort(key=lambda r: (r.get("ts")
+                             if isinstance(r.get("ts"), (int, float))
+                             else 0.0))
+    return recs
+
+
+def aggregate(paths: Union[str, Sequence[str]]) -> Dict:
+    """Parse metrics JSONL stream(s) into {rounds: [...], finals:
+    {...}}. A single path or a list of per-host paths (merged on
+    ts+proc tags).
 
     `finals` is keyed by "host/pid": counters are per-process, so on a
     merged multi-process stream one last-record-wins snapshot would
     silently report a single process's totals as the run's."""
+    if isinstance(paths, str):
+        paths = [paths]
     rounds: List[Dict] = []
     finals: Dict[str, Dict] = {}
     # counters are PER-PROCESS (the registry dies with the process) and
@@ -56,7 +81,7 @@ def aggregate(path: str) -> Dict:
     # or a post-resume record would mis-subtract the dead process's
     # totals (under- or over-counting depending on magnitudes)
     prev_by_proc: Dict[str, Dict[str, int]] = {}
-    for rec in read_jsonl(path):
+    for rec in _read_merged(paths):
         kind = rec.get("kind")
         metrics = rec.get("metrics") or {}
         if kind == "round":
@@ -147,7 +172,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not paths:
         print(__doc__)
         return 1
-    agg = aggregate(paths[0])
+    agg = aggregate(paths)
     if as_json:
         print(json.dumps(agg, indent=2, default=str))
     else:

@@ -386,6 +386,76 @@ step_fn = jax.jit(step)
 
 
 # ---------------------------------------------------------------------------
+# GL008 metric-name-style
+# ---------------------------------------------------------------------------
+def test_gl008_off_grammar_names_flag(tmp_path):
+    fs = _lint(tmp_path, """
+from cxxnet_tpu import telemetry
+
+def f(tel):
+    telemetry.inc("trainstep")        # single segment
+    telemetry.set_gauge("Train.Loss", 1)   # uppercase
+    tel.observe("train.step time", 0.1)    # space
+    telemetry.get().counter("train-step.count")  # dash
+""")
+    assert _rules(fs) == ["GL008"] * 4
+    assert "parallel series" in fs[0].message
+
+
+def test_gl008_conforming_and_dynamic_names_ok(tmp_path):
+    fs = _lint(tmp_path, """
+from cxxnet_tpu import telemetry
+
+def f(tel, name):
+    telemetry.inc("train.step")
+    telemetry.observe("io.prefetch.wait_s", 0.1)
+    tel.beacon("serve.batch")
+    telemetry.inc(name)          # dynamic: caller's responsibility
+    telemetry.event("span", x=1)  # event kinds are not series names
+    with tel.span("round"):       # spans nest short segments by design
+        with tel.span("step"):
+            pass
+""")
+    assert _rules(fs) == []
+
+
+def test_gl008_unrelated_receivers_not_flagged(tmp_path):
+    # .observe()/.inc() APIs on non-telemetry objects are out of
+    # scope - including identifiers that merely CONTAIN "tel"
+    fs = _lint(tmp_path, """
+def f(watcher, stats, hotel, intel):
+    watcher.observe("whatever format", 1)
+    stats.inc("Also Not A Metric")
+    hotel.observe("room rate", 1)
+    intel.inc("CPU Temp")
+""")
+    assert _rules(fs) == []
+
+
+def test_gl008_exact_tel_identifiers_flag(tmp_path):
+    fs = _lint(tmp_path, """
+def f(self, tel, _tel, my_tel):
+    tel.inc("BadName")
+    _tel.observe("AlsoBad", 1)
+    my_tel.set_gauge("StillBad", 1)
+    self._tel.span("Worst")
+""")
+    assert _rules(fs) == ["GL008"] * 4
+
+
+def test_gl008_waivable(tmp_path):
+    fs = _lint(tmp_path, """
+from cxxnet_tpu import telemetry
+
+def f():
+    # graftlint: disable=GL008 legacy dashboard series, renaming would orphan its history
+    telemetry.inc("legacyCounter")
+""")
+    assert _rules(fs) == []
+    assert _rules(fs, waived=True) == ["GL008"]
+
+
+# ---------------------------------------------------------------------------
 # waivers
 # ---------------------------------------------------------------------------
 def test_waiver_same_line_and_standalone(tmp_path):

@@ -1,0 +1,836 @@
+"""Live observability plane tests (docs/OBSERVABILITY.md): Prometheus
+exposition correctness, /healthz + /varz endpoints, alert rule matrix
+(threshold / rate / absence with hysteresis), watchdog stall dumps,
+cross-host aggregation, multi-file metrics_report merge, heartbeat
+shutdown hardening, and the off-by-default parity guarantees."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cxxnet_tpu import telemetry
+from cxxnet_tpu.telemetry import Telemetry
+from cxxnet_tpu.telemetry.alerts import AlertEngine, load_rules
+from cxxnet_tpu.telemetry.http import (
+    PROM_CONTENT_TYPE, ObservabilityServer, prom_label_escape,
+    prom_name, render_prometheus, validate_exposition)
+from cxxnet_tpu.telemetry.sink import read_jsonl
+from cxxnet_tpu.telemetry.watchdog import Watchdog
+from cxxnet_tpu.tools.agg import Aggregator, make_source
+from cxxnet_tpu.tools.metrics_report import aggregate
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+def test_prom_name_mapping():
+    assert prom_name("train.step_s") == "cxxnet_train_step_s"
+    assert prom_name("io.prefetch.depth") == "cxxnet_io_prefetch_depth"
+    assert prom_name("9weird name") == "cxxnet__9weird_name"
+
+
+def test_prom_label_escaping():
+    assert prom_label_escape('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+def test_render_every_instrument_kind():
+    tel = Telemetry()
+    tel.inc("fault.retry", 3)
+    tel.set_gauge("train.loss", 0.25)
+    for v in (0.01, 0.02, 0.03, 0.04):
+        tel.observe("train.step_s", v)
+    text = render_prometheus(tel)
+    assert validate_exposition(text) == []
+    lines = text.splitlines()
+    assert "# TYPE cxxnet_fault_retry_total counter" in lines
+    assert "cxxnet_fault_retry_total 3" in lines
+    assert "# TYPE cxxnet_train_loss gauge" in lines
+    assert "cxxnet_train_loss 0.25" in lines
+    assert "# TYPE cxxnet_train_step_s summary" in lines
+    assert any(l.startswith('cxxnet_train_step_s{quantile="0.5"} ')
+               for l in lines)
+    assert any(l.startswith('cxxnet_train_step_s{quantile="0.99"} ')
+               for l in lines)
+    assert "cxxnet_train_step_s_count 4" in lines
+    assert any(l.startswith("cxxnet_train_step_s_sum 0.1")
+               for l in lines)
+
+
+def test_render_empty_histogram_and_weird_tags():
+    tel = Telemetry()
+    tel.histogram("serve.latency_s")  # no observations: NaN quantiles
+    tel.set_tags(host='h"x\\y\nz')
+    text = render_prometheus(tel)
+    assert validate_exposition(text) == []
+    assert 'cxxnet_serve_latency_s{quantile="0.5"} NaN' in text
+    assert 'host="h\\"x\\\\y\\nz"' in text  # escaped, single line
+    assert "cxxnet_serve_latency_s_count 0" in text
+
+
+def test_validate_exposition_catches_garbage():
+    assert validate_exposition("ok_metric 1\n") == []
+    assert validate_exposition("bad metric name 1\n")
+    assert validate_exposition("x{unclosed=\"v\" 1\n")
+    assert validate_exposition("# FROB x y\n")
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+# ---------------------------------------------------------------------------
+def test_http_endpoints_metrics_varz_healthz_404():
+    tel = Telemetry()
+    tel.inc("train.images", 64)
+    srv = ObservabilityServer(tel, 0, host="127.0.0.1").start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, ctype, body = _get(base + "/metrics")
+        assert code == 200 and ctype == PROM_CONTENT_TYPE
+        assert validate_exposition(body.decode()) == []
+        assert "cxxnet_train_images_total 64" in body.decode()
+
+        code, ctype, body = _get(base + "/varz")
+        assert code == 200 and ctype == "application/json"
+        rec = json.loads(body)
+        # the /varz body IS a metrics-stream record: same tags, same
+        # metrics payload shape as emit_metrics writes
+        assert rec["kind"] == "varz"
+        for key in ("ts", "host", "pid", "proc"):
+            assert key in rec
+        assert rec["metrics"]["train.images"] == 64
+
+        code, _, body = _get(base + "/healthz")
+        assert code == 200 and json.loads(body)["ok"] is True
+
+        tel.health.set_unhealthy("watchdog", "no progress for 99s")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/healthz")
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read())
+        assert payload["ok"] is False
+        assert "watchdog" in payload["reasons"]
+
+        tel.health.clear("watchdog")
+        code, _, _ = _get(base + "/healthz")
+        assert code == 200
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+    # closed = socket really released
+    with pytest.raises(OSError):
+        _get(f"http://127.0.0.1:{srv.port}/healthz", timeout=0.5)
+
+
+def test_server_scrapes_do_not_touch_std_streams(capfd):
+    tel = Telemetry()
+    srv = ObservabilityServer(tel, 0, host="127.0.0.1").start()
+    try:
+        _get(f"http://127.0.0.1:{srv.port}/metrics")
+        _get(f"http://127.0.0.1:{srv.port}/varz")
+    finally:
+        srv.close()
+    out, err = capfd.readouterr()
+    assert out == "" and err == ""  # no BaseHTTPRequestHandler logging
+
+
+# ---------------------------------------------------------------------------
+# alert rules
+# ---------------------------------------------------------------------------
+def _engine(tel, rules, **kw):
+    eng = AlertEngine(tel, [dict(r) for r in rules], **kw)
+    return eng
+
+
+def test_threshold_rule_for_secs_and_recovery():
+    tel = Telemetry()
+    now = time.monotonic()
+    eng = _engine(tel, [{
+        "name": "q", "type": "threshold", "metric": "serve.queue_depth",
+        "op": ">", "value": 10, "for_secs": 5}])
+    tel.set_gauge("serve.queue_depth", 50)
+    assert eng.check_now(now) == []          # pending, not yet firing
+    assert eng.check_now(now + 4.9) == []
+    assert eng.check_now(now + 5.0) == ["q"]  # sustained for_secs
+    ok, reasons = tel.health.status()
+    assert not ok and "alert:q" in reasons
+    tel.set_gauge("serve.queue_depth", 2)
+    assert eng.check_now(now + 6.0) == []     # resolved
+    assert tel.health.ok
+    assert tel.registry.counter("alert.fired").value == 1
+    assert tel.registry.counter("alert.resolved").value == 1
+
+
+def test_threshold_blip_does_not_fire():
+    tel = Telemetry()
+    now = time.monotonic()
+    eng = _engine(tel, [{
+        "name": "q", "type": "threshold", "metric": "serve.queue_depth",
+        "op": ">", "value": 10, "for_secs": 5}])
+    tel.set_gauge("serve.queue_depth", 50)
+    assert eng.check_now(now) == []
+    tel.set_gauge("serve.queue_depth", 0)    # recovered inside window
+    assert eng.check_now(now + 3) == []
+    tel.set_gauge("serve.queue_depth", 50)   # pending restarts
+    assert eng.check_now(now + 4) == []
+    assert eng.check_now(now + 8.9) == []
+    assert eng.check_now(now + 9.0) == ["q"]
+
+
+def test_threshold_hysteresis_clear_secs():
+    tel = Telemetry()
+    now = time.monotonic()
+    eng = _engine(tel, [{
+        "name": "q", "type": "threshold", "metric": "serve.queue_depth",
+        "op": ">", "value": 10, "for_secs": 0, "clear_secs": 10}])
+    tel.set_gauge("serve.queue_depth", 99)
+    assert eng.check_now(now) == ["q"]
+    tel.set_gauge("serve.queue_depth", 0)
+    # below threshold but within the clear window: still firing (a
+    # flapping metric must not strobe /healthz)
+    assert eng.check_now(now + 5) == ["q"]
+    assert not tel.health.ok
+    tel.set_gauge("serve.queue_depth", 99)   # re-trips: clear resets
+    assert eng.check_now(now + 8) == ["q"]
+    tel.set_gauge("serve.queue_depth", 0)
+    assert eng.check_now(now + 9) == ["q"]
+    assert eng.check_now(now + 19.5) == []   # clear_secs elapsed
+    assert tel.health.ok
+
+
+def test_threshold_histogram_stat():
+    tel = Telemetry()
+    now = time.monotonic()
+    eng = _engine(tel, [{
+        "name": "slow", "type": "threshold",
+        "metric": "serve.latency_s", "op": ">", "value": 0.5,
+        "for_secs": 0, "stat": "p99"}])
+    for _ in range(99):
+        tel.observe("serve.latency_s", 0.01)
+    assert eng.check_now(now) == []
+    for _ in range(40):
+        tel.observe("serve.latency_s", 2.0)
+    assert eng.check_now(now + 1) == ["slow"]
+
+
+def test_rate_rule_honors_for_secs_sustain():
+    tel = Telemetry()
+    now = time.monotonic()
+    eng = _engine(tel, [{
+        "name": "nan", "type": "rate", "metric": "fault.nan_rollback",
+        "max_per_min": 3, "window_secs": 600, "for_secs": 100}])
+    assert eng.check_now(now) == []
+    tel.inc("fault.nan_rollback", 50)
+    # rate exceeds 3/min from t=60 on, but must SUSTAIN for_secs
+    assert eng.check_now(now + 60) == []
+    assert eng.check_now(now + 120) == []
+    assert eng.check_now(now + 161) == ["nan"]
+
+
+def test_rule_numeric_fields_validated():
+    tel = Telemetry()
+    with pytest.raises(ValueError, match="must be a number"):
+        _engine(tel, [{"name": "q", "type": "threshold",
+                       "metric": "m.x", "op": ">", "value": "256"}])
+    with pytest.raises(ValueError, match="must be a number"):
+        _engine(tel, [{"name": "s", "type": "absence", "beacon": "b.c",
+                       "for_secs": "120"}])
+
+
+def test_broken_rule_does_not_block_later_rules(tmp_path, capfd):
+    tel = Telemetry()
+    now = time.monotonic()
+    eng = _engine(tel, [
+        {"name": "bad", "type": "threshold", "metric": "m.x",
+         "op": ">", "value": 1, "for_secs": 0},
+        {"name": "good", "type": "threshold", "metric": "m.y",
+         "op": ">", "value": 1, "for_secs": 0}])
+    # sabotage rule 0 post-validation (stands in for any eval blowup)
+    eng.states[0].rule["op"] = "bogus"
+    tel.observe("m.x", 5)
+    tel.set_gauge("m.y", 5)
+    assert eng.check_now(now) == ["good"]    # isolation: good still fires
+    assert eng.check_now(now + 1) == ["good"]
+    err = capfd.readouterr().err
+    assert err.count("failed to evaluate") == 1  # noted once
+
+
+def test_rate_rule_counts_increments_per_minute():
+    tel = Telemetry()
+    now = time.monotonic()
+    eng = _engine(tel, [{
+        "name": "nan", "type": "rate", "metric": "fault.nan_rollback",
+        "max_per_min": 3, "window_secs": 60}])
+    assert eng.check_now(now) == []          # baseline sample
+    tel.inc("fault.nan_rollback", 2)
+    assert eng.check_now(now + 60) == []     # 2/min: under
+    tel.inc("fault.nan_rollback", 30)
+    assert eng.check_now(now + 120) == ["nan"]  # burst
+    # counter goes quiet: the window drains and the rule resolves
+    assert eng.check_now(now + 300) == []
+
+
+def test_absence_rule_beacon_and_startup_grace():
+    tel = Telemetry()
+    now = time.monotonic()
+    eng = _engine(tel, [{
+        "name": "stall", "type": "absence", "beacon": "train.step",
+        "for_secs": 10, "startup_grace_secs": 60}])
+    eng._armed_at = now
+    # never seen: quiet through the startup grace, then fires
+    assert eng.check_now(now + 30) == []
+    assert eng.check_now(now + 61) == ["stall"]
+    tel.beacon("train.step")                 # progress: real monotonic
+    real = time.monotonic()
+    assert eng.check_now(real) == []         # resolved
+    assert tel.health.ok
+    assert eng.check_now(real + 10.5) == ["stall"]  # went quiet again
+
+
+def test_alert_cmd_hook_runs(tmp_path):
+    tel = Telemetry()
+    now = time.monotonic()
+    marker = tmp_path / "hook.out"
+    eng = _engine(
+        tel,
+        [{"name": "q", "type": "threshold", "metric": "x.y",
+          "op": ">", "value": 1, "for_secs": 0}],
+        alert_cmd=f'echo "$ALERT_NAME $ALERT_STATE" >> {marker}')
+    tel.set_gauge("x.y", 5)
+    assert eng.check_now(now) == ["q"]
+    tel.set_gauge("x.y", 0)
+    assert eng.check_now(now + 1) == []
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if (marker.exists()
+                and len(marker.read_text().splitlines()) >= 2):
+            break
+        time.sleep(0.05)
+    lines = marker.read_text().splitlines()
+    assert lines[0] == "q firing"
+    assert lines[1] == "q resolved"
+
+
+def test_alert_events_on_stream(tmp_path):
+    tel = Telemetry()
+    log = str(tmp_path / "ev.jsonl")
+    tel.configure(log_file=log)
+    now = time.monotonic()
+    eng = _engine(tel, [{
+        "name": "q", "type": "threshold", "metric": "x.y",
+        "op": ">=", "value": 1, "for_secs": 0}])
+    tel.set_gauge("x.y", 1)
+    eng.check_now(now)
+    tel.set_gauge("x.y", 0)
+    eng.check_now(now + 1)
+    tel.close()
+    alerts = [e for e in read_jsonl(log) if e["kind"] == "alert"]
+    assert [a["state"] for a in alerts] == ["firing", "resolved"]
+    assert alerts[0]["name"] == "q"
+    assert "x.y" in alerts[0]["message"]
+
+
+def test_engine_close_clears_firing_health():
+    tel = Telemetry()
+    eng = _engine(tel, [{
+        "name": "q", "type": "threshold", "metric": "x.y",
+        "op": ">", "value": 1, "for_secs": 0}])
+    tel.set_gauge("x.y", 5)
+    eng.check_now(time.monotonic())
+    assert not tel.health.ok
+    eng.close()
+    assert tel.health.ok
+
+
+def test_load_rules_validation(tmp_path):
+    def write(rules):
+        p = tmp_path / "r.json"
+        p.write_text(json.dumps(rules))
+        return str(p)
+
+    ok = load_rules(write([{"type": "absence", "beacon": "train.step",
+                            "for_secs": 5}]))
+    assert ok[0]["name"] == "rule0"  # defaulted
+    with pytest.raises(ValueError, match="unknown type"):
+        load_rules(write([{"type": "frobnicate"}]))
+    with pytest.raises(ValueError, match="unknown key"):
+        load_rules(write([{"type": "absence", "beacon": "b",
+                           "for_secs": 5, "for_sec": 5}]))
+    with pytest.raises(ValueError, match="op"):
+        load_rules(write([{"type": "threshold", "metric": "m",
+                           "op": "~", "value": 1}]))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_rules(write([
+            {"name": "a", "type": "absence", "beacon": "b",
+             "for_secs": 1},
+            {"name": "a", "type": "absence", "beacon": "c",
+             "for_secs": 1}]))
+    with pytest.raises(ValueError, match="JSON list"):
+        load_rules(write({"rules": "nope"}))
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+def test_watchdog_stall_dump_and_recovery(tmp_path, capfd):
+    tel = Telemetry()
+    log = str(tmp_path / "ev.jsonl")
+    tel.configure(log_file=log)
+    with tel.span("train.chunk"):
+        pass
+    now = time.monotonic()
+    wd = Watchdog(tel, 5.0)
+    wd._armed_at = now
+    tel.beacon("train.step")
+    base = time.monotonic()
+    assert wd.check_now(base + 1) is False
+    assert wd.check_now(base + 6) is True     # stalled
+    assert wd.check_now(base + 7) is True     # same episode: one dump
+    ok, reasons = tel.health.status()
+    assert not ok and "watchdog" in reasons
+    tel.beacon("train.step")
+    assert wd.check_now(time.monotonic()) is False
+    assert tel.health.ok
+    tel.close()
+    err = capfd.readouterr().err
+    # the stderr dump names this very test frame and the recent span
+    assert "watchdog: no progress" in err
+    assert "test_watchdog_stall_dump_and_recovery" in err
+    assert "train.chunk" in err
+    events = list(read_jsonl(log))
+    dumps = [e for e in events if e.get("kind") == "watchdog"
+             and e.get("op") == "stall_dump"]
+    assert len(dumps) == 1                    # one dump per episode
+    assert "test_watchdog_stall_dump_and_recovery" in dumps[0]["stacks"]
+    assert dumps[0]["spans"][-1]["name"] == "train.chunk"
+    recs = [e for e in events if e.get("kind") == "watchdog"
+            and e.get("op") == "recovered"]
+    assert len(recs) == 1
+    assert tel.registry.counter("watchdog.stalls").value == 1
+
+
+def test_watchdog_startup_grace_before_first_beacon():
+    tel = Telemetry()
+    now = time.monotonic()
+    wd = Watchdog(tel, 2.0, startup_secs=60.0)
+    wd._armed_at = now
+    # no beacon yet: compile/init time far past stall_secs stays green
+    assert wd.check_now(now + 30) is False
+    assert wd.check_now(now + 61) is True
+
+
+def test_watchdog_close_clears_health():
+    tel = Telemetry()
+    now = time.monotonic()
+    wd = Watchdog(tel, 1.0, startup_secs=1.0)
+    wd._armed_at = now
+    assert wd.check_now(now + 2) is True
+    assert not tel.health.ok
+    wd.close()
+    assert tel.health.ok
+
+
+# ---------------------------------------------------------------------------
+# heartbeat hardening (fake clock)
+# ---------------------------------------------------------------------------
+class _FakeClockWaiter:
+    """Stands in for Event.wait: the test releases one tick at a time;
+    wait() returns False to tick, True to stop."""
+
+    def __init__(self):
+        self.tick = threading.Semaphore(0)
+        self.stopped = threading.Event()
+        self.ticked = 0
+
+    def __call__(self, interval):
+        self.tick.acquire()
+        self.ticked += 1
+        return self.stopped.is_set()
+
+
+def test_heartbeat_no_snapshot_after_final(tmp_path):
+    tel = Telemetry()
+    met = str(tmp_path / "m.jsonl")
+    waiter = _FakeClockWaiter()
+    tel._hb_waiter = waiter
+    tel.configure(metrics_file=met, heartbeat_secs=9999.0)
+    waiter.tick.release()            # one beat
+    deadline = time.monotonic() + 5.0
+    while waiter.ticked < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.05)                 # let the beat finish writing
+    tel.emit_metrics(kind="final")
+    waiter.tick.release()            # a tick racing the shutdown...
+    time.sleep(0.1)
+    kinds = [r["kind"] for r in read_jsonl(met)]
+    # ...must emit nothing: `final` is the stream's terminal record
+    assert kinds == ["heartbeat", "final"]
+    waiter.stopped.set()
+    waiter.tick.release()
+    tel.close()
+
+
+def test_heartbeat_close_is_bounded_with_huge_interval(tmp_path):
+    tel = Telemetry()
+    met = str(tmp_path / "m.jsonl")
+    tel.configure(metrics_file=met, heartbeat_secs=9999.0)
+    t0 = time.monotonic()
+    tel.close()                      # must not wait out the interval
+    assert time.monotonic() - t0 < 3.0
+    assert [r["kind"] for r in read_jsonl(met)] == []
+
+
+def test_heartbeat_tick_after_close_emits_nothing(tmp_path):
+    tel = Telemetry()
+    met = str(tmp_path / "m.jsonl")
+    waiter = _FakeClockWaiter()
+    tel._hb_waiter = waiter
+    tel.configure(metrics_file=met, heartbeat_secs=9999.0)
+    tel._hb_waiter = None
+    # close() while the thread is blocked on the fake clock: the
+    # bounded join returns, the zombie's next tick sees its own
+    # (already-set) stop event and emits nothing
+    tel.close()
+    waiter.tick.release()
+    time.sleep(0.1)
+    assert not os.path.exists(met) or \
+        [r["kind"] for r in read_jsonl(met)] == []
+
+
+# ---------------------------------------------------------------------------
+# cross-host aggregation (tools/agg.py)
+# ---------------------------------------------------------------------------
+def _host_stream(path, host, pid, p50, rounds=(1, 2), ts0=1000.0):
+    recs = []
+    for i, rnd in enumerate(rounds):
+        recs.append({
+            "ts": ts0 + 10 * i, "kind": "round", "host": host,
+            "pid": pid, "proc": 0 if host == "a" else 1, "round": rnd,
+            "images_per_sec": 100.0,
+            "metrics": {
+                "train.step_s": {"count": 8 * rnd, "sum": p50 * 8,
+                                 "p50": p50, "p99": p50 * 2},
+                "train.loss": 0.5 / rnd,
+                "train.images": 256 * rnd,
+                "fault.nan_rollback": 0,
+            }})
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_agg_merges_two_host_streams_and_flags_straggler(tmp_path):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _host_stream(a, "a", 1, p50=0.010)
+    _host_stream(b, "b", 2, p50=0.050)   # 5x slower: the straggler
+    agg = Aggregator([make_source(a), make_source(b)])
+    assert agg.poll() == 4
+    d = agg.to_dict(now=1020.0)
+    assert set(d["hosts"]) == {"a/1", "b/2"}
+    assert d["hosts"]["a/1"]["round"] == 2
+    assert d["hosts"]["a/1"]["step_p50_ms"] == pytest.approx(10.0)
+    assert d["hosts"]["b/2"]["step_p50_ms"] == pytest.approx(50.0)
+    assert d["spread"]["ratio"] == pytest.approx(5.0)
+    assert "STRAGGLER" in d["hosts"]["b/2"]["flags"]
+    assert "STRAGGLER" not in d["hosts"]["a/1"]["flags"]
+    table = agg.render(now=1020.0)
+    assert "a/1" in table and "b/2" in table
+    assert "STRAGGLER" in table
+    assert "step p50 spread" in table
+
+
+def test_agg_tails_appended_records_and_flags_stale(tmp_path):
+    a = str(tmp_path / "a.jsonl")
+    _host_stream(a, "a", 1, p50=0.010, rounds=(1,))
+    src = make_source(a)
+    agg = Aggregator([src], stale_secs=30.0)
+    agg.poll()
+    assert agg.hosts["a/1"].round == 1
+    # live tail: append one more round + a torn partial line
+    with open(a, "a") as f:
+        f.write(json.dumps({
+            "ts": 1100.0, "kind": "round", "host": "a", "pid": 1,
+            "round": 5, "metrics": {}}) + "\n")
+        f.write('{"ts": 1200.0, "kind": "rou')   # torn mid-write
+    agg.poll()
+    assert agg.hosts["a/1"].round == 5
+    assert agg.hosts["a/1"].last_ts == 1100.0
+    d = agg.to_dict(now=1400.0)   # 300s quiet > 30s stale threshold
+    assert "STALE" in d["hosts"]["a/1"]["flags"]
+
+
+def test_agg_scrapes_varz_endpoint():
+    tel = Telemetry()
+    tel.inc("train.images", 512)
+    for v in (0.01, 0.02):
+        tel.observe("train.step_s", v)
+    srv = ObservabilityServer(tel, 0, host="127.0.0.1").start()
+    try:
+        src = make_source(f"http://127.0.0.1:{srv.port}")
+        agg = Aggregator([src])
+        assert agg.poll() == 1
+        (key, host), = agg.hosts.items()
+        assert host.steps == 2
+        assert host.step_p50_ms == pytest.approx(15.0)
+    finally:
+        srv.close()
+    # endpoint gone: polls degrade to counted errors, state survives
+    assert agg.poll() == 0
+    assert src.errors == 1
+    assert list(agg.hosts) == [key]
+
+
+def test_make_source_kinds(tmp_path):
+    from cxxnet_tpu.tools.agg import _JsonlSource, _VarzSource
+    assert isinstance(make_source("x/y.jsonl"), _JsonlSource)
+    assert isinstance(make_source("host:9100"), _VarzSource)
+    assert isinstance(make_source("http://h:91/varz"), _VarzSource)
+    assert make_source("h:9100").url.endswith("/varz")
+
+
+# ---------------------------------------------------------------------------
+# metrics_report: multi-file pod merge
+# ---------------------------------------------------------------------------
+def test_metrics_report_merges_per_host_files(tmp_path):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    recs_a = [
+        {"ts": 10.0, "kind": "round", "host": "a", "pid": 1,
+         "round": 1, "steps": 8,
+         "metrics": {"fault.retry": 1}},
+        {"ts": 30.0, "kind": "round", "host": "a", "pid": 1,
+         "round": 2, "steps": 8,
+         "metrics": {"fault.retry": 4}},
+        {"ts": 40.0, "kind": "final", "host": "a", "pid": 1,
+         "metrics": {"fault.retry": 4}},
+    ]
+    recs_b = [
+        {"ts": 20.0, "kind": "round", "host": "b", "pid": 2,
+         "round": 1, "steps": 8,
+         "metrics": {"fault.retry": 2}},
+        {"ts": 41.0, "kind": "final", "host": "b", "pid": 2,
+         "metrics": {"fault.retry": 2}},
+    ]
+    for path, recs in ((a, recs_a), (b, recs_b)):
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+    agg = aggregate([a, b])
+    # merged on ts: a@10, b@20, a@30 - and the per-process counter
+    # deltas are not corrupted by the interleave
+    assert [(r["proc"], r["round"]) for r in agg["rounds"]] == \
+        [("a/1", 1), ("b/2", 1), ("a/1", 2)]
+    assert [r["retries"] for r in agg["rounds"]] == [1, 2, 3]
+    assert agg["finals"]["a/1"]["fault.retry"] == 4
+    assert agg["finals"]["b/2"]["fault.retry"] == 2
+    # single-path string form still works (the PR-2 surface)
+    assert len(aggregate(a)["rounds"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# off-by-default contract + arm/disarm lifecycle
+# ---------------------------------------------------------------------------
+def _obs_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith("telemetry-")]
+
+
+def test_arm_observability_all_off_is_a_noop():
+    assert telemetry.arm_observability() is None
+    assert telemetry.arm_observability(
+        metrics_port=None, alert_rules="", alert_cmd="",
+        watchdog_secs=0.0) is None
+    assert _obs_threads() == []
+
+
+def test_arm_and_disarm_lifecycle(tmp_path):
+    rules = tmp_path / "r.json"
+    rules.write_text(json.dumps([
+        {"name": "stall", "type": "absence", "beacon": "train.step",
+         "for_secs": 30}]))
+    srv = telemetry.arm_observability(
+        metrics_port=0, alert_rules=str(rules), watchdog_secs=30.0)
+    try:
+        assert srv is not None and srv.port > 0
+        names = _obs_threads()
+        assert "telemetry-http" in names
+        assert "telemetry-watchdog" in names
+        assert "telemetry-alerts" in names
+        code, _, _ = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert code == 200
+    finally:
+        telemetry.disarm_observability()
+    deadline = time.monotonic() + 5.0
+    while _obs_threads() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert _obs_threads() == []
+    with pytest.raises(OSError):
+        _get(f"http://127.0.0.1:{srv.port}/healthz", timeout=0.5)
+
+
+def test_close_tears_down_observability(tmp_path):
+    srv = telemetry.arm_observability(metrics_port=0)
+    assert srv is not None
+    telemetry.close()
+    assert _obs_threads() == []
+
+
+def test_watchdog_only_arming_adds_no_per_step_cost():
+    """watchdog_secs (or alert_rules) alone must NOT flip `enabled` -
+    that would latch the trainer's per-step device syncs and the
+    diagnostic would perturb the thing it diagnoses. Forensics run on
+    beacons (unconditional) + the span ring, which fills whenever
+    span records are emitted."""
+    telemetry.arm_observability(watchdog_secs=60.0)
+    try:
+        assert not telemetry.enabled()
+    finally:
+        telemetry.disarm_observability()
+
+
+def test_span_events_fill_recent_ring():
+    tel = Telemetry()
+    tel.configure()  # no sink: event() itself is a no-op write...
+    # ...but the trainer's direct span-event form must still feed the
+    # ring whenever it fires (it is gated on `enabled` at the caller)
+    tel.event("span", name="train.step", secs=0.01, round=1)
+    tel.event("span", name="train.data", secs=0.002)
+    assert [s["name"] for s in tel.recent_spans()] == \
+        ["train.step", "train.data"]
+    # span() contexts land exactly once (no double-append via event)
+    tel2 = Telemetry()
+    tel2._http = object()  # stand-in: any armed consumer
+    with tel2.span("round"):
+        pass
+    assert [s["name"] for s in tel2.recent_spans()] == ["round"]
+
+
+def test_beacons_are_thread_safe():
+    n_threads, per_thread = 8, 500
+
+    def mark():
+        for _ in range(per_thread):
+            telemetry.beacon("serve.batch")
+
+    threads = [threading.Thread(target=mark) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    count, _ = telemetry.beacons()["serve.batch"]
+    assert count == n_threads * per_thread
+
+
+def test_beacon_accumulates_count_and_timestamp():
+    t0 = time.monotonic()
+    telemetry.beacon("train.step")
+    telemetry.beacon("train.step", 4)
+    b = telemetry.beacons()
+    count, ts = b["train.step"]
+    assert count == 5
+    assert t0 <= ts <= time.monotonic()
+
+
+def test_cli_run_with_metrics_port_live_scrape(tmp_path, capfd):
+    """End-to-end: a real training run with the plane armed serves
+    live scrapes, and the server dies with the run."""
+    import socket
+
+    from test_cli import write_conf, write_synth_mnist
+
+    from cxxnet_tpu.main import LearnTask
+
+    tr = write_synth_mnist(tmp_path, n=256, seed=0, prefix="train")
+    te = write_synth_mnist(tmp_path, n=64, seed=1, prefix="test")
+    conf = write_conf(tmp_path, *tr, *te,
+                      extra="num_round = 2\nmax_round = 2\n")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    got = {"metrics": None, "healthz": None, "varz": None}
+    stop = threading.Event()
+
+    def poll():
+        base = f"http://127.0.0.1:{port}"
+        while not stop.wait(0.05):
+            try:
+                code, ctype, body = _get(base + "/metrics",
+                                         timeout=1.0)
+                if code == 200:
+                    got["metrics"] = (ctype, body.decode())
+                code, _, _ = _get(base + "/healthz", timeout=1.0)
+                got["healthz"] = code
+                _, _, body = _get(base + "/varz", timeout=1.0)
+                got["varz"] = json.loads(body)
+            except (OSError, ValueError):
+                continue
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    try:
+        rc = LearnTask().run([conf, f"metrics_port={port}",
+                              "watchdog_secs=60"])
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert rc == 0
+    capfd.readouterr()
+    assert got["metrics"] is not None, "no live scrape landed"
+    ctype, body = got["metrics"]
+    assert ctype == PROM_CONTENT_TYPE
+    assert validate_exposition(body) == []
+    assert "cxxnet_train_step_s" in body
+    assert got["healthz"] == 200
+    assert got["varz"]["kind"] == "varz"
+    # run over: plane torn down with it
+    assert _obs_threads() == []
+    with pytest.raises(OSError):
+        _get(f"http://127.0.0.1:{port}/healthz", timeout=0.5)
+
+
+def test_cli_unarmed_run_spawns_no_observability(tmp_path, capfd):
+    """Off-by-default contract: no obs keys -> no plane threads, no
+    socket, and the CLI output carries no observability text."""
+    from test_cli import write_conf, write_synth_mnist
+
+    from cxxnet_tpu.main import LearnTask
+
+    tr = write_synth_mnist(tmp_path, n=128, seed=0, prefix="train")
+    te = write_synth_mnist(tmp_path, n=64, seed=1, prefix="test")
+    conf = write_conf(tmp_path, *tr, *te,
+                      extra="num_round = 1\nmax_round = 1\n")
+    rc = LearnTask().run([conf])
+    assert rc == 0
+    assert _obs_threads() == []
+    out, err = capfd.readouterr()
+    for needle in ("watchdog", "alert", "metrics", "healthz"):
+        assert needle not in out
+        assert needle not in err
+
+
+def test_schema_recognizes_observability_keys():
+    from cxxnet_tpu.analysis import schema
+    reg = schema.get_registry(refresh=True)
+    for key in ("metrics_port", "alert_rules", "alert_cmd",
+                "watchdog_secs"):
+        assert reg.recognizes(key), key
+    assert reg.suggest("metrics_portt") == "metrics_port"

@@ -148,7 +148,7 @@ def test_jsonl_round_trip_with_tags(tmp_path):
     tel.configure(log_file=log, metrics_file=met,
                   tags={"device": "cpu"})
     tel.inc("fault.retry", 2)
-    tel.observe("step_s", 0.25)
+    tel.observe("train.step_s", 0.25)
     tel.event("checkpoint", op="save", round=3, secs=0.5, bytes=123)
     tel.emit_metrics(kind="round", round=3)
     tel.close()
@@ -163,7 +163,7 @@ def test_jsonl_round_trip_with_tags(tmp_path):
     assert len(recs) == 1
     m = recs[0]["metrics"]
     assert m["fault.retry"] == 2
-    assert m["step_s"]["count"] == 1
+    assert m["train.step_s"]["count"] == 1
     assert recs[0]["round"] == 3
 
 
