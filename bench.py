@@ -1816,6 +1816,7 @@ def run(profile_dir="", steps_override=0, batch_override=0) -> dict:
             _snapshot(out)
     _measure_graftlint(out)
     _measure_obs(out)
+    _measure_lock_audit(out)
     _snapshot(out)
     _finalize(out, platform)
     return out
@@ -1887,6 +1888,26 @@ def _measure_obs(out: dict) -> None:
             srv.close()
     except Exception as e:  # noqa: BLE001 - extras must not kill bench
         out["obs_error"] = f"{type(e).__name__}: {e}"
+
+
+def _measure_lock_audit(out: dict) -> None:
+    """Wall-time + worst held-duration of the runtime lock audit's
+    jax-free scenarios (docs/STATIC_ANALYSIS.md "Concurrency
+    analysis") - the concurrency gate gets a perf trajectory like
+    graftlint_s, and the contention gauges (`lock.audit.*`) land in
+    the telemetry registry as a side effect. The serve-storm scenario
+    stays in CI only: it rebuilds a trainer, which would perturb the
+    bench window. Guarded like every extra."""
+    try:
+        from cxxnet_tpu.analysis.lock_audit import run_lock_audit
+        rep = run_lock_audit(
+            scenarios=("prefetch-round", "watchdog-stall"))
+        out["lock_audit_s"] = rep["elapsed_s"]
+        out["lock_max_held_ms"] = rep["max_held_ms"]
+        if rep["failed"]:
+            out["lock_audit_failed"] = rep["failed"]
+    except Exception as e:  # noqa: BLE001 - extras must not kill bench
+        out["lock_audit_error"] = f"{type(e).__name__}: {e}"
 
 
 def _finalize(out: dict, platform: str) -> None:

@@ -15,6 +15,15 @@ tiers verify them statically:
   the lowered artifact - no f64 leaks, no host callbacks, buffer
   donation actually applied, no weight-sized captured constants, and
   a stable recompile count across a round with a short final chunk.
+- **concurrency tier** (the GL01x rules in astlint.py +
+  lock_audit.py): lock discipline linted in the source (bare
+  acquires, daemon-less threads, unlocked thread-target writes,
+  timeout-less joins, predicate-less Condition.wait, blocking calls
+  under a lock, ``# guarded-by:`` annotations), then verified LIVE -
+  a Lock/RLock construction shim records per-thread acquisition
+  sequences over the real serve/prefetch/watchdog paths, fails on a
+  cyclic lock-order graph or a lock held across a jax dispatch
+  boundary, and reports contention.
 
 Plus the **config schema registry** (schema.py): every recognized
 config key, generated from the source tree's ``set_param`` handlers,
@@ -23,8 +32,9 @@ normal config parsing (main.py); ``--check-configs`` sweeps conf
 trees.
 
 CLI: ``python -m cxxnet_tpu.analysis [paths] [--check-configs DIR]
-[--jaxpr-audit] [--json FILE]`` - exit 0 iff zero unwaived findings
-and every audit check passed. CI runs it as a blocking job.
+[--jaxpr-audit] [--lock-audit] [--json FILE]`` - exit 0 iff zero
+unwaived findings and every audit check passed. CI runs it as the
+blocking ``static-analysis`` and ``concurrency-audit`` jobs.
 """
 
 from cxxnet_tpu.analysis.astlint import (

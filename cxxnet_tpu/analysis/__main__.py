@@ -12,6 +12,12 @@ report):
   --jaxpr-audit         tier-2: trace the real train/eval executables
                         and assert on the lowered artifact (imports
                         jax - run under JAX_PLATFORMS=cpu in CI)
+  --lock-audit          concurrency tier-2: run the serve-storm /
+                        prefetch-round / watchdog-stall scenarios
+                        under the lock shim and assert an acyclic
+                        lock-order graph, no lock held across a jax
+                        dispatch boundary, and non-vacuous coverage
+                        (docs/STATIC_ANALYSIS.md)
 
 Options:
 
@@ -22,6 +28,14 @@ Options:
   --dump-keys           print the generated config-key registry
   --max-seconds S       fail if the tier-1 lint exceeded S seconds
                         (the CI perf budget for the analysis pass)
+  --lock-audit-scenarios a,b
+                        restrict the lock audit to a scenario subset
+  --lock-audit-max-seconds S
+                        fail if the lock audit exceeded S seconds
+  --seed-inversion      inject the deliberate two-lock ABBA fixture
+                        into the lock audit - the gate's self-test
+                        (the audit MUST then exit non-zero; CI runs
+                        this leg and asserts the failure)
 
 Exit codes: 0 = clean (zero unwaived findings, all audit checks
 pass), 1 = findings/audit failures, 2 = usage error.
@@ -62,6 +76,12 @@ def main(argv=None) -> int:
     ap.add_argument("--check-configs", action="append", default=[],
                     metavar="DIR")
     ap.add_argument("--jaxpr-audit", action="store_true")
+    ap.add_argument("--lock-audit", action="store_true")
+    ap.add_argument("--lock-audit-scenarios", default="",
+                    metavar="a,b")
+    ap.add_argument("--lock-audit-max-seconds", type=float,
+                    default=0.0)
+    ap.add_argument("--seed-inversion", action="store_true")
     ap.add_argument("--json", dest="json_out", default="")
     ap.add_argument("--rules", default="")
     ap.add_argument("--show-waived", action="store_true")
@@ -69,6 +89,9 @@ def main(argv=None) -> int:
     ap.add_argument("--dump-keys", action="store_true")
     ap.add_argument("--max-seconds", type=float, default=0.0)
     args = ap.parse_args(argv)
+    if args.seed_inversion and not args.lock_audit:
+        print("--seed-inversion requires --lock-audit")
+        return 2
 
     if args.list_rules:
         for rid, name in sorted(RULES.items()):
@@ -89,7 +112,8 @@ def main(argv=None) -> int:
 
     # -- tier 1: AST lint ---------------------------------------------------
     run_lint = bool(args.paths) or not (args.check_configs
-                                        or args.jaxpr_audit)
+                                        or args.jaxpr_audit
+                                        or args.lock_audit)
     if run_lint:
         paths = args.paths or [_DEFAULT_PATH]
         # a missing path or an empty tree must FAIL, not vacuously
@@ -175,6 +199,44 @@ def main(argv=None) -> int:
               f"{n_fail} failed")
         report["audit"] = audit
         if n_fail:
+            failed = True
+
+    # -- concurrency tier 2: runtime lock audit -----------------------------
+    if args.lock_audit:
+        from cxxnet_tpu.analysis.lock_audit import run_lock_audit
+        scen = tuple(s.strip()
+                     for s in args.lock_audit_scenarios.split(",")
+                     if s.strip()) or None
+        try:
+            audit = run_lock_audit(scenarios=scen,
+                                   seed_inversion=args.seed_inversion)
+        except ValueError as e:  # unknown scenario name = usage error
+            print(f"lock-audit: {e}")
+            return 2
+        for chk in audit["checks"]:
+            mark = "ok" if chk["ok"] else "FAIL"
+            print(f"  [{mark}] {chk['target']}: {chk['check']}"
+                  + (f" - {chk['detail']}" if chk.get("detail")
+                     else ""))
+        for site in audit["contended"]:
+            print(f"  contended: {site['site']} "
+                  f"({site['kind']}, x{site['instances']}) "
+                  f"acq={site['acquisitions']} "
+                  f"wait={site['wait_total_ms']:.1f}ms "
+                  f"held_max={site['held_max_ms']:.1f}ms")
+        print(f"lock-audit: {len(audit['checks'])} checks, "
+              f"{audit['failed']} failed; {audit['sites']} lock "
+              f"sites, {len(audit['edges'])} order edges, "
+              f"{audit['elapsed_s']:.1f}s")
+        report["lock_audit"] = audit
+        if audit["failed"]:
+            failed = True
+        budget = args.lock_audit_max_seconds
+        if budget and audit["elapsed_s"] > budget:
+            print(f"lock-audit: FAIL - audit took "
+                  f"{audit['elapsed_s']:.1f}s, budget is "
+                  f"{budget:.0f}s")
+            audit["over_budget"] = True
             failed = True
 
     if args.json_out:

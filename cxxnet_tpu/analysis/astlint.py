@@ -47,6 +47,20 @@ Rules (stable ids - the waiver/CI contract; docs/STATIC_ANALYSIS.md):
   out_shardings/donation - which is what a waiver documents): the
   exact accidental-full-materialization the ZeRO stages exist to
   remove (docs/parallel.md).
+- **GL010..GL016 concurrency tier** (docs/STATIC_ANALYSIS.md
+  "Concurrency analysis"): lock-discipline rules over the runtime's
+  threading surface - bare ``.acquire()`` outside ``with``/
+  try-finally (GL010), ``threading.Thread`` that never sets
+  ``daemon=`` (GL011), a thread target/``run`` method writing
+  instance or module state with no lock in scope (GL012),
+  ``.join()`` with no timeout on a thread (GL013), ``Condition.wait``
+  not wrapped in a predicate ``while`` loop (GL014), blocking calls
+  (``queue.get`` / ``accept`` / un-timeouted ``wait`` / ``sleep`` /
+  subprocess waits) made while a lock is held (GL015), and the
+  ``# guarded-by: <lock>`` annotation convention - every write to an
+  annotated attribute must sit inside a ``with <lock>`` block in the
+  same function (GL016). The runtime half of the tier is
+  ``analysis/lock_audit.py``.
 - **GL090 bad-waiver**: a waiver without a reason, or naming an
   unknown rule id. Waivers are documentation; undocumented ones are
   findings themselves.
@@ -86,13 +100,30 @@ RULES: Dict[str, str] = {
     "GL006": "unknown-config-key",
     "GL007": "unsharded-large-intermediate",
     "GL008": "metric-name-style",
+    "GL010": "bare-acquire",
+    "GL011": "thread-daemon-missing",
+    "GL012": "unlocked-thread-shared-write",
+    "GL013": "join-no-timeout",
+    "GL014": "condition-wait-no-predicate",
+    "GL015": "blocking-call-under-lock",
+    "GL016": "guarded-by-violation",
     "GL090": "bad-waiver",
     "GL091": "unused-waiver",
 }
 
+# the GL01x subset: the concurrency tier the CI `concurrency-audit`
+# job gates on (together with waiver hygiene, which cannot be waived)
+CONCURRENCY_RULES = ("GL010", "GL011", "GL012", "GL013", "GL014",
+                     "GL015", "GL016")
+
 _WAIVE_RE = re.compile(
     r"graftlint:\s*disable=([A-Za-z0-9_,\s]*?)(?:\s+(.*))?$")
 _HOT_RE = re.compile(r"graftlint:\s*hot-path\b")
+# the guarded-by annotation grammar (docs/STATIC_ANALYSIS.md): names
+# the lock expression protecting the attribute whose initialization
+# the comment sits on (or above) - `self._lock`, or a bare module
+# lock name
+_GUARDED_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
 
 # jax.random calls that CONSUME a key (one draw per key). fold_in /
 # split / PRNGKey / key / key_data DERIVE - deriving twice is the
@@ -164,6 +195,9 @@ class _FileCtx:
     mesh_aware: bool = False
     waivers: List[_Waiver] = field(default_factory=list)
     hot_lines: Set[int] = field(default_factory=set)
+    # raw `# guarded-by:` notes: (target_line, lock_text, comment_line)
+    guard_notes: List[Tuple[int, str, int]] = field(
+        default_factory=list)
     jitted: Set[str] = field(default_factory=set)
     donated: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
     findings: List[Finding] = field(default_factory=list)
@@ -194,6 +228,10 @@ def _scan_comments(ctx: _FileCtx, source: str) -> None:
         target = line_no + 1 if standalone else line_no
         if _HOT_RE.search(tok.string):
             ctx.hot_lines.add(target)
+            continue
+        g = _GUARDED_RE.search(tok.string)
+        if g:
+            ctx.guard_notes.append((target, g.group(1), line_no))
             continue
         m = _WAIVE_RE.search(tok.string)
         if not m:
@@ -875,6 +913,570 @@ def _rule_metric_names(ctx: _FileCtx) -> None:
 
 
 # ---------------------------------------------------------------------------
+# GL010-GL016: the concurrency tier (lock discipline)
+# ---------------------------------------------------------------------------
+# receiver-name fallbacks: a lock PASSED into a function has no
+# visible construction, but the repo's naming is consistent enough
+# that the suffix identifies it
+_LOCKNAME_RE = re.compile(r"(^|_)(lock|mutex)s?$", re.IGNORECASE)
+_CONDNAME_RE = re.compile(r"(^|_)cond(ition)?$", re.IGNORECASE)
+
+
+def _dotted_text(e: ast.expr) -> str:
+    """`self._cond` / `mod.lock` -> their dotted text, WITHOUT the
+    ast.unparse cost; "" for anything that is not a plain Name/
+    Attribute chain (such receivers are never lock-flavored)."""
+    parts = []
+    while isinstance(e, ast.Attribute):
+        parts.append(e.attr)
+        e = e.value
+    if not isinstance(e, ast.Name):
+        return ""
+    parts.append(e.id)
+    parts.reverse()
+    return ".".join(parts)
+_LOCK_FACTORIES = frozenset({"Lock", "RLock"})
+_COND_FACTORIES = frozenset({"Condition"})
+_EVENT_FACTORIES = frozenset({"Event", "Semaphore", "BoundedSemaphore",
+                              "Barrier"})
+_QUEUE_FACTORIES = frozenset({"Queue", "LifoQueue", "PriorityQueue",
+                              "SimpleQueue"})
+_SUBPROC_BLOCKERS = frozenset({"run", "check_call", "check_output",
+                               "call"})
+
+
+@dataclass
+class _ConcInfo:
+    """Module-wide concurrency flavor map: which expression texts are
+    locks, conditions, events, queues, threads - collected from their
+    construction sites, like the donated-arg registry."""
+    locks: Set[str] = field(default_factory=set)
+    conds: Set[str] = field(default_factory=set)
+    events: Set[str] = field(default_factory=set)
+    queues: Set[str] = field(default_factory=set)
+    threads: Set[str] = field(default_factory=set)
+    thread_classes: Set[str] = field(default_factory=set)
+    # attr name -> (lock expr text, declaration line)
+    guarded: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+
+    def lockish(self, text: str) -> bool:
+        if not text:
+            return False
+        if text in self.locks or text in self.conds:
+            return True
+        if text in self.events or text in self.queues:
+            return False
+        last = text.rsplit(".", 1)[-1]
+        return bool(_LOCKNAME_RE.search(last)
+                    or _CONDNAME_RE.search(last))
+
+    def condish(self, text: str) -> bool:
+        if text in self.conds:
+            return True
+        if (text in self.events or text in self.locks
+                or text in self.queues):
+            return False
+        return bool(_CONDNAME_RE.search(text.rsplit(".", 1)[-1]))
+
+    def queueish(self, text: str) -> bool:
+        if text in self.queues:
+            return True
+        last = text.rsplit(".", 1)[-1].lower().lstrip("_")
+        return last in ("q", "queue") or last.endswith("_q") \
+            or "queue" in last
+
+    def threadish(self, text: str) -> bool:
+        if text in self.threads:
+            return True
+        return "thread" in text.rsplit(".", 1)[-1].lower()
+
+
+def _is_thread_base(base: ast.expr) -> bool:
+    return _last_name(base) == "Thread"
+
+
+def _conc_collect(ctx: _FileCtx,
+                  nodes: Sequence[ast.AST]) -> _ConcInfo:
+    conc = _ConcInfo()
+    # pass 0: Thread subclasses (their constructors are thread
+    # factories too)
+    for node in nodes:
+        if isinstance(node, ast.ClassDef) and any(
+                _is_thread_base(b) for b in node.bases):
+            conc.thread_classes.add(node.name)
+    # pass 1: factory assignments
+    for node in nodes:
+        targets: List[ast.expr] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if not (isinstance(value, ast.Call) and targets):
+            continue
+        name = _last_name(value.func)
+        dest = (conc.locks if name in _LOCK_FACTORIES
+                else conc.conds if name in _COND_FACTORIES
+                else conc.events if name in _EVENT_FACTORIES
+                else conc.queues if name in _QUEUE_FACTORIES
+                else conc.threads if (name == "Thread"
+                                      or name in conc.thread_classes)
+                else None)
+        if dest is None:
+            continue
+        for t in targets:
+            if isinstance(t, (ast.Name, ast.Attribute)):
+                text = _dotted_text(t)
+                if text:
+                    dest.add(text)
+    # pass 2: thread collections (`self._threads.append(t)`) and loop
+    # variables over them (`for t in self._threads:`)
+    coll: Set[str] = set()
+    for node in nodes:
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append" and node.args
+                and _dotted_text(node.args[0]) in conc.threads):
+            coll.add(_dotted_text(node.func.value))
+    for node in nodes:
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            it = _dotted_text(node.iter)
+            if it in coll or conc.threadish(it):
+                for n in _assigned_names(node.target):
+                    conc.threads.add(n)
+    # guarded-by notes -> attribute registry (GL016). The note must
+    # sit on (or above) an attribute assignment - a dangling note is
+    # itself a finding, not silently-ignored documentation
+    decl_lines: Dict[int, str] = {}
+    for node in nodes:
+        tgt = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            tgt = node.target
+        if isinstance(tgt, ast.Attribute) and isinstance(
+                tgt.ctx, ast.Store):
+            decl_lines.setdefault(node.lineno, tgt.attr)
+    for target_line, lock_text, src_line in ctx.guard_notes:
+        attr = decl_lines.get(target_line)
+        if attr is None:
+            ctx.findings.append(Finding(
+                "GL016", ctx.rel, src_line, 0,
+                f"guarded-by annotation '{lock_text}' matches no "
+                f"attribute assignment on line {target_line}"))
+            continue
+        conc.guarded[attr] = (lock_text, target_line)
+    return conc
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return bool(call.args) or any(kw.arg == "timeout"
+                                  for kw in call.keywords)
+
+
+def _releases(stmts: Sequence[ast.stmt], text: str) -> bool:
+    for st in stmts:
+        for n in _walk_no_funcs_inclusive(st):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "release"
+                    and _dotted_text(n.func.value) == text):
+                return True
+    return False
+
+
+def _store_attr_targets(st: ast.stmt) -> List[ast.expr]:
+    """Store targets of a simple statement, with subscripts unwrapped
+    to their base (`self._hits[k] = v` writes `self._hits`)."""
+    if isinstance(st, ast.Assign):
+        targets = list(st.targets)
+    elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+        targets = [st.target]
+    else:
+        return []
+    out = []
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, (ast.Name, ast.Attribute)) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                out.append(n)
+            elif isinstance(n, ast.Subscript) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                base = n.value
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, (ast.Name, ast.Attribute)):
+                    out.append(base)
+    return out
+
+
+def _guard_matches(held: Sequence[str], base_text: str,
+                   lock_text: str) -> bool:
+    """Does any held `with` context satisfy the guarded-by note? The
+    note is written against the declaring object (`self._lock`); a
+    write through another base (`_TEL._beacons`) must hold the SAME
+    lock attribute on ITS base (`_TEL._beacon_lock`)."""
+    if lock_text in held:
+        return True
+    if "." in lock_text and base_text:
+        expected = base_text + "." + lock_text.rsplit(".", 1)[-1]
+        return expected in held
+    return False
+
+
+def _scan_concurrency_scope(ctx: _FileCtx, conc: _ConcInfo,
+                            body: Sequence[ast.stmt],
+                            fname: str) -> None:
+    """GL010/GL011/GL013/GL014/GL015/GL016 over one scope (a function
+    body or the module body), tracking the lexical `with <lock>` stack
+    and predicate-loop nesting."""
+    in_init = fname == "__init__"
+
+    def check_call(n: ast.Call, held: List[str], in_while: bool,
+                   sibling_try: Optional[ast.Try],
+                   fin_releases: Set[str]) -> None:
+        func = n.func
+        if not isinstance(func, ast.Attribute):
+            return
+        recv = _dotted_text(func.value)
+        attr = func.attr
+        if attr == "acquire" and conc.lockish(recv):
+            ok = (recv in fin_releases
+                  or (sibling_try is not None
+                      and _releases(sibling_try.finalbody, recv)))
+            if not ok:
+                ctx.emit(
+                    "GL010", n,
+                    f"bare {recv}.acquire() with no try/finally "
+                    f"release - an exception here leaks the lock "
+                    f"forever; use `with {recv}:`")
+        elif (attr == "wait" and conc.condish(recv)
+                and not in_while):
+            ctx.emit(
+                "GL014", n,
+                f"{recv}.wait() outside a predicate `while` loop - "
+                f"condition waits wake spuriously and on stale "
+                f"notifies; re-check the predicate in a loop "
+                f"(`while not <pred>: {recv}.wait(...)`)")
+        elif (attr == "join" and not n.args and not n.keywords
+                and conc.threadish(recv)):
+            ctx.emit(
+                "GL013", n,
+                f"{recv}.join() with no timeout - a wedged thread "
+                f"hangs shutdown forever; join with a timeout and "
+                f"handle the still-alive case")
+        if not held:
+            return
+        # --- GL015: blocking while a lock is held ---
+        what = ""
+        if (attr == "get" and conc.queueish(recv)
+                and not (n.args
+                         and isinstance(n.args[0], ast.Constant)
+                         and n.args[0].value is False)):
+            what = f"{recv}.get()"
+        elif attr == "accept" and not n.args:
+            what = f"{recv}.accept()"
+        elif (attr in ("wait", "communicate")
+                and recv not in held       # cond.wait on the HELD
+                and not _has_timeout(n)):  # lock releases it
+            what = f"{recv}.{attr}()"
+        elif attr == "join" and not n.args and not n.keywords \
+                and conc.threadish(recv):
+            what = f"{recv}.join()"
+        elif attr == "sleep":
+            what = f"{recv}.sleep()"
+        elif (attr in _SUBPROC_BLOCKERS
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "subprocess"
+                and not any(kw.arg == "timeout" for kw in n.keywords)):
+            what = f"subprocess.{attr}()"
+        if what:
+            ctx.emit(
+                "GL015", n,
+                f"blocking {what} while holding {held[-1]} - every "
+                f"other thread needing the lock stalls behind this "
+                f"wait (and a producer/consumer pair deadlocks); "
+                f"move the blocking call outside the `with` block")
+
+    def check_thread_ctor(n: ast.Call, st: ast.stmt,
+                          body_: Sequence[ast.stmt], idx: int) -> None:
+        if _last_name(n.func) != "Thread":
+            return
+        if any(kw.arg == "daemon" for kw in n.keywords):
+            return
+        # `t = Thread(...)` followed by `t.daemon = ...` in the same
+        # scope counts
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name):
+            tname = st.targets[0].id
+            for later in body_[idx + 1:]:
+                for sub in _walk_no_funcs_inclusive(later):
+                    if (isinstance(sub, ast.Attribute)
+                            and sub.attr == "daemon"
+                            and isinstance(sub.ctx, ast.Store)
+                            and _dotted_text(sub.value) == tname):
+                        return
+        ctx.emit(
+            "GL011", n,
+            "threading.Thread() without daemon= - an undecided "
+            "lifetime either blocks interpreter exit (non-daemon "
+            "leak) or dies mid-write (accidental daemon); decide "
+            "explicitly")
+
+    def check_guarded_stores(st: ast.stmt, held: List[str]) -> None:
+        if in_init:
+            return  # construction precedes publication
+        for tgt in _store_attr_targets(st):
+            if not isinstance(tgt, ast.Attribute):
+                continue
+            note = conc.guarded.get(tgt.attr)
+            if note is None:
+                continue
+            lock_text, decl_line = note
+            if st.lineno == decl_line:
+                continue
+            base_text = _dotted_text(tgt.value)
+            if not _guard_matches(held, base_text, lock_text):
+                ctx.emit(
+                    "GL016", tgt,
+                    f"write to '{_dotted_text(tgt)}' outside `with "
+                    f"{lock_text}` - the field is annotated "
+                    f"guarded-by: {lock_text} (declared line "
+                    f"{decl_line})")
+
+    def exprs_of(st: ast.stmt) -> List[ast.expr]:
+        out = []
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                out.append(child)
+        return out
+
+    def check_exprs(exprs: Sequence[ast.expr], held: List[str],
+                    in_while: bool, sibling_try: Optional[ast.Try],
+                    fin_releases: Set[str], body_: Sequence[ast.stmt],
+                    idx: int, st: ast.stmt) -> None:
+        for e in exprs:
+            for n in _walk_no_funcs_inclusive(e):
+                if isinstance(n, ast.Call):
+                    check_call(n, held, in_while, sibling_try,
+                               fin_releases)
+                    check_thread_ctor(n, st, body_, idx)
+
+    def scan(body_: Sequence[ast.stmt], held: List[str],
+             in_while: bool, fin_releases: Set[str]) -> None:
+        for idx, st in enumerate(body_):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            nxt = body_[idx + 1] if idx + 1 < len(body_) else None
+            sibling_try = nxt if isinstance(nxt, ast.Try) else None
+            check_guarded_stores(st, held)
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                pushed = list(held)
+                ctx_exprs = []
+                for item in st.items:
+                    ctx_exprs.append(item.context_expr)
+                    t = _dotted_text(item.context_expr)
+                    if conc.lockish(t):
+                        pushed = pushed + [t]
+                check_exprs(ctx_exprs, held, in_while, sibling_try,
+                            fin_releases, body_, idx, st)
+                scan(st.body, pushed, in_while, fin_releases)
+            elif isinstance(st, ast.While):
+                # a wait in the loop TEST is the predicate-loop idiom
+                # too (`while not ev.wait(t): ...`)
+                check_exprs([st.test], held, True, sibling_try,
+                            fin_releases, body_, idx, st)
+                scan(st.body, held, True, fin_releases)
+                scan(st.orelse, held, in_while, fin_releases)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                check_exprs([st.iter], held, in_while, sibling_try,
+                            fin_releases, body_, idx, st)
+                scan(st.body, held, in_while, fin_releases)
+                scan(st.orelse, held, in_while, fin_releases)
+            elif isinstance(st, ast.If):
+                check_exprs([st.test], held, in_while, sibling_try,
+                            fin_releases, body_, idx, st)
+                scan(st.body, held, in_while, fin_releases)
+                scan(st.orelse, held, in_while, fin_releases)
+            elif isinstance(st, ast.Try):
+                # an acquire in the try body excused by this try's own
+                # finally-release (the acquire-then-try idiom)
+                fin = set(fin_releases)
+                for fin_st in st.finalbody:
+                    for n in _walk_no_funcs_inclusive(fin_st):
+                        if (isinstance(n, ast.Call)
+                                and isinstance(n.func, ast.Attribute)
+                                and n.func.attr == "release"):
+                            fin.add(_dotted_text(n.func.value))
+                scan(st.body, held, in_while, fin)
+                for h in st.handlers:
+                    scan(h.body, held, in_while, fin_releases)
+                scan(st.orelse, held, in_while, fin_releases)
+                scan(st.finalbody, held, in_while, fin_releases)
+            else:
+                check_exprs(exprs_of(st), held, in_while, sibling_try,
+                            fin_releases, body_, idx, st)
+
+    scan(body, [], False, set())
+
+
+def _thread_target_functions(
+        ctx: _FileCtx, conc: _ConcInfo,
+        nodes: Sequence[ast.AST]) -> List[ast.AST]:
+    """Functions that run ON a spawned thread: `target=` of a Thread
+    construction (bare name, local closure, or `self._method`), and
+    the `run` method of every Thread subclass."""
+    by_name: Dict[str, List[ast.AST]] = {}
+    for node in nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    out: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(fn: ast.AST) -> None:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append(fn)
+
+    for node in nodes:
+        if isinstance(node, ast.ClassDef) and any(
+                _is_thread_base(b) for b in node.bases):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) \
+                        and item.name == "run":
+                    add(item)
+        if not (isinstance(node, ast.Call)
+                and (_last_name(node.func) == "Thread"
+                     or _last_name(node.func) in conc.thread_classes)):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            name = ""
+            if isinstance(kw.value, ast.Name):
+                name = kw.value.id
+            elif (isinstance(kw.value, ast.Attribute)
+                  and isinstance(kw.value.value, ast.Name)
+                  and kw.value.value.id in ("self", "cls")):
+                name = kw.value.attr
+            for fn in by_name.get(name, ()):
+                add(fn)
+    return out
+
+
+def _rule_unlocked_thread_writes(ctx: _FileCtx, conc: _ConcInfo,
+                                 fn: ast.AST) -> None:
+    """GL012 over one thread-target function: stores to instance
+    attributes (`self.x = ...`) or declared-global names with no lock
+    held are cross-thread data races waiting for a reader. Fields
+    carrying a guarded-by annotation are GL016's responsibility; the
+    fix is a lock, a queue handoff, or the annotation."""
+    fname = getattr(fn, "name", "<lambda>")
+    args = getattr(fn, "args", None)
+    self_name = ""
+    if args is not None:
+        pos = list(args.posonlyargs) + list(args.args)
+        if pos and pos[0].arg in ("self", "cls"):
+            self_name = pos[0].arg
+    declared_globals: Set[str] = set()
+    for n in _walk_no_funcs(fn):
+        if isinstance(n, ast.Global):
+            declared_globals.update(n.names)
+
+    def scan(body: Sequence[ast.stmt], held: bool) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                locked = held or any(
+                    conc.lockish(_dotted_text(i.context_expr))
+                    for i in st.items)
+                scan(st.body, locked)
+                continue
+            if not held:
+                for tgt in _store_attr_targets(st):
+                    what = ""
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == self_name
+                            and self_name):
+                        if tgt.attr in conc.guarded:
+                            continue  # GL016 checks those
+                        what = _dotted_text(tgt)
+                    elif (isinstance(tgt, ast.Name)
+                          and tgt.id in declared_globals):
+                        what = f"global {tgt.id}"
+                    if what:
+                        ctx.emit(
+                            "GL012", tgt,
+                            f"thread target '{fname}' writes shared "
+                            f"state '{what}' with no lock in scope - "
+                            f"a concurrent reader sees torn/stale "
+                            f"state; guard it with a lock, hand it "
+                            f"over a queue, or annotate the field "
+                            f"guarded-by its lock")
+            for sub in (getattr(st, "body", None),
+                        getattr(st, "orelse", None),
+                        getattr(st, "finalbody", None)):
+                if sub:
+                    scan(sub, held)
+            for h in getattr(st, "handlers", []) or []:
+                scan(h.body, held)
+
+    scan(getattr(fn, "body", []), False)
+
+
+def _rule_thread_subclass_daemon(ctx: _FileCtx,
+                                 nodes: Sequence[ast.AST]) -> None:
+    """GL011's class form: a Thread subclass must decide daemon-ness
+    in its __init__ (super().__init__(daemon=...) or self.daemon=)."""
+    for node in nodes:
+        if not (isinstance(node, ast.ClassDef)
+                and any(_is_thread_base(b) for b in node.bases)):
+            continue
+        init = next((f for f in node.body
+                     if isinstance(f, ast.FunctionDef)
+                     and f.name == "__init__"), None)
+        decided = False
+        if init is not None:
+            for n in _walk_no_funcs(init):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "__init__"
+                        and any(kw.arg == "daemon"
+                                for kw in n.keywords)):
+                    decided = True
+                elif (isinstance(n, ast.Attribute)
+                      and n.attr == "daemon"
+                      and isinstance(n.ctx, ast.Store)):
+                    decided = True
+        if not decided:
+            ctx.emit(
+                "GL011", node,
+                f"Thread subclass '{node.name}' never sets daemon= "
+                f"(inherits non-daemon: a leaked instance blocks "
+                f"interpreter exit); pass daemon= to "
+                f"super().__init__ or set self.daemon in __init__")
+
+
+def _concurrency_pass(ctx: _FileCtx) -> None:
+    # one pre-walked node list shared by every sub-pass (ast.walk is
+    # the dominant cost of walking the same tree nine times)
+    nodes = list(ast.walk(ctx.tree))
+    conc = _conc_collect(ctx, nodes)
+    _rule_thread_subclass_daemon(ctx, nodes)
+    # every scope: module body + each function body
+    _scan_concurrency_scope(ctx, conc, ctx.tree.body, "<module>")
+    for node in nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_concurrency_scope(ctx, conc, node.body, node.name)
+    for fn in _thread_target_functions(ctx, conc, nodes):
+        _rule_unlocked_thread_writes(ctx, conc, fn)
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 def _function_visits(ctx: _FileCtx) -> None:
@@ -920,6 +1522,7 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     _module_pass(ctx)
     _rule_wallclock(ctx)
     _rule_metric_names(ctx)
+    _concurrency_pass(ctx)
     _function_visits(ctx)
     _apply_waivers(ctx)
     ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))

@@ -19,7 +19,8 @@ import numpy as np
 from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.data import DataBatch, DataInst
 from cxxnet_tpu.io.iterators import DataIter
-from cxxnet_tpu.io.thread_util import drain_and_join, stoppable_put
+from cxxnet_tpu.io.thread_util import (
+    ErrorBox, drain_and_join, stoppable_put)
 
 
 class BatchAdaptIterator(DataIter):
@@ -152,8 +153,9 @@ class ThreadBufferIterator(DataIter):
                     return
         except BaseException as e:  # noqa: BLE001 - re-raised in next()
             # a producer failure must surface in the consumer, not
-            # masquerade as a clean end-of-data
-            self._exc = e
+            # masquerade as a clean end-of-data (lock-guarded handoff:
+            # the write is published before the sentinel put below)
+            self._err.put(e)
         finally:
             stoppable_put(q, stop, None)
 
@@ -161,7 +163,7 @@ class ThreadBufferIterator(DataIter):
         self._shutdown()
         self._stop = threading.Event()
         self._q = queue.Queue(maxsize=self.buffer_size)
-        self._exc = None
+        self._err = ErrorBox()
         self._done = False
         self._thread = threading.Thread(
             target=self._producer, args=(self._q, self._stop), daemon=True)
@@ -182,8 +184,8 @@ class ThreadBufferIterator(DataIter):
         item = self._q.get()
         if item is None:
             self._done = True
-            if self._exc is not None:
-                exc, self._exc = self._exc, None
+            exc = self._err.take()
+            if exc is not None:
                 raise RuntimeError(
                     "ThreadBufferIterator: producer thread failed") \
                     from exc

@@ -24,7 +24,8 @@ import numpy as np
 from cxxnet_tpu import telemetry
 from cxxnet_tpu.io.data import DataInst
 from cxxnet_tpu.io.iterators import DataIter
-from cxxnet_tpu.io.thread_util import drain_and_join, stoppable_put
+from cxxnet_tpu.io.thread_util import (
+    ErrorBox, drain_and_join, stoppable_put)
 from cxxnet_tpu.utils.binary_page import iter_page_blobs
 
 
@@ -139,7 +140,7 @@ class _PageReader(threading.Thread):
         self.paths = paths
         self.out_q = out_q
         self.stop_event = stop
-        self.exc = None
+        self.err = ErrorBox()
 
     def _put(self, item) -> bool:
         return stoppable_put(self.out_q, self.stop_event, item)
@@ -152,7 +153,8 @@ class _PageReader(threading.Thread):
                         if not self._put(blobs):
                             return
         except BaseException as e:  # noqa: BLE001 - re-raised by consumer
-            self.exc = e
+            # lock-guarded handoff, published before the sentinel put
+            self.err.put(e)
         finally:
             self._put(None)  # sentinel
 
@@ -309,9 +311,8 @@ class ImageBinIterator(DataIter):
     def _next_page(self) -> bool:
         blobs = self._q.get()
         if blobs is None:
-            exc = getattr(self._reader, "exc", None)
+            exc = self._reader.err.take()
             if exc is not None:
-                self._reader.exc = None
                 raise RuntimeError(
                     "imgbin page reader failed") from exc
             return False

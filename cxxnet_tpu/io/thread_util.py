@@ -13,6 +13,34 @@ import threading
 import time
 
 
+class ErrorBox:
+    """Single-slot cross-thread exception handoff: the producer
+    ``put``s its failure, the consumer ``take``s it after the queue's
+    sentinel arrives. The box is what makes the publication explicit -
+    a bare ``self._exc = e`` on the worker is exactly the unlocked
+    shared-state write the GL012 lint rule exists to catch (the queue
+    sentinel *usually* orders it, but nothing says so in the code).
+    First error wins; ``take`` clears the slot."""
+
+    __slots__ = ("_lock", "_exc")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._exc = None
+
+    def put(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._exc is None:
+                self._exc = exc
+
+    def take(self):
+        """Return-and-clear the stored exception (None if clean)."""
+        with self._lock:
+            exc, self._exc = self._exc, None
+            return exc
+
+
 def stoppable_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
     """Bounded put that aborts when `stop` is set. Returns False if
     aborted (the producer should exit)."""

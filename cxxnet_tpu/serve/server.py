@@ -205,10 +205,15 @@ class Server:
         self.metrics_port = metrics_port
         self.metrics_host = metrics_host
         self.metrics_server = None
-        self._queue: collections.deque = collections.deque()
         self._cond = threading.Condition()
+        # admission state: the queue, its row count and the drain flag
+        # move together under the condition (checked statically -
+        # docs/STATIC_ANALYSIS.md GL016)
+        self._queue: collections.deque = collections.deque()
+        # guarded-by: self._cond
         self._queued_rows = 0
         self._threads: List[threading.Thread] = []
+        # guarded-by: self._cond
         self._draining = False
         self._started = False
         self.warmup_s = 0.0
@@ -217,11 +222,17 @@ class Server:
         # the first one's counts OR its latency window); the registry
         # mirrors everything for the metrics stream/report
         self._lock = threading.Lock()
+        # guarded-by: self._lock
         self._n_requests = 0
+        # guarded-by: self._lock
         self._n_rows = 0
+        # guarded-by: self._lock
         self._n_batches = 0
+        # guarded-by: self._lock
         self._n_padding = 0
+        # guarded-by: self._lock
         self._n_errors = 0
+        # guarded-by: self._lock
         self._bucket_hits: Dict[int, int] = {b: 0 for b in self.buckets}
         self._lat = telemetry.Histogram()
 
@@ -265,7 +276,11 @@ class Server:
             telemetry.event("observability", op="http_start",
                             port=self.metrics_server.port,
                             host=self.metrics_host)
-        self._draining = False
+        with self._cond:
+            # published under the lock that guards it: a replica from
+            # a previous start/stop cycle draining late must not read
+            # a torn flag
+            self._draining = False
         self._started = True
         for i in range(self.replicas):
             t = threading.Thread(target=self._replica_loop,

@@ -121,17 +121,19 @@ class Telemetry:
         # test hook: a fake-clock wait fn (signature of Event.wait)
         # injected by the heartbeat-hardening tests; None = real clock
         self._hb_waiter = None
+        self._emit_lock = threading.Lock()
         # `final` snapshot emitted: the heartbeat must never write a
         # trailing snapshot after it (the stream's terminal record);
         # the flag is checked-and-written under _emit_lock
+        # guarded-by: self._emit_lock
         self._finalized = False
-        self._emit_lock = threading.Lock()
         self._local = threading.local()
         # progress beacons (watchdog.py / absence alert rules):
         # name -> (count, monotonic ts of the newest mark); locked -
         # serve replicas mark the same beacon concurrently and an
         # unlocked read-modify-write would drop counts
         self._beacon_lock = threading.Lock()
+        # guarded-by: self._beacon_lock
         self._beacons: Dict[str, Tuple[int, float]] = {}
         self._recent_spans: collections.deque = collections.deque(
             maxlen=RECENT_SPANS)
@@ -166,7 +168,11 @@ class Telemetry:
                          if metrics_file else None)
         if tags:
             self._tags.update(tags)
-        self._finalized = False
+        with self._emit_lock:
+            # under the lock: a heartbeat that outlived its bounded
+            # join (blocked on a slow disk) could still be inside
+            # emit_metrics when the next run re-arms
+            self._finalized = False
         self.heartbeat_secs = float(heartbeat_secs or 0.0)
         if self.heartbeat_secs > 0 and (self._log or self._metrics):
             self._start_heartbeat()
@@ -550,9 +556,11 @@ def reset_for_tests() -> None:
     _TEL.close()
     _TEL.registry.reset()
     _TEL.health.reset()
-    _TEL._beacons = {}
+    with _TEL._beacon_lock:
+        _TEL._beacons = {}
     _TEL._recent_spans.clear()
-    _TEL._finalized = False
+    with _TEL._emit_lock:
+        _TEL._finalized = False
     _TEL._hb_waiter = None
     _TEL._tags = {"host": socket.gethostname(), "pid": os.getpid(),
                   "proc": 0}

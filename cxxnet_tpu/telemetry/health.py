@@ -22,6 +22,7 @@ class HealthState:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        # guarded-by: self._lock
         self._reasons: Dict[str, str] = {}
 
     def set_unhealthy(self, source: str, reason: str) -> None:

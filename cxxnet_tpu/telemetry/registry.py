@@ -152,6 +152,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        # guarded-by: self._lock
         self._instruments: Dict[str, Instrument] = {}
 
     def _get(self, name: str, cls) -> Instrument:

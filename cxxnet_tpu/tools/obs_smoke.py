@@ -130,6 +130,10 @@ class _Poller(threading.Thread):
         super().__init__(name="obs-smoke-poller", daemon=True)
         self.base = f"http://127.0.0.1:{port}"
         self.stop = threading.Event()
+        # sample fields shared with the main thread (read after
+        # stop+join, but the lock makes the handoff explicit - the
+        # GL012 lock-discipline rule flags bare cross-thread writes)
+        self._lock = threading.Lock()
         self.codes = []          # de-duplicated /healthz code timeline
         self.metrics_bodies = []  # (healthz_code_at_sample, body)
         self.content_type = ""
@@ -156,18 +160,23 @@ class _Poller(threading.Thread):
             try:
                 with urllib.request.urlopen(self.base + "/metrics",
                                             timeout=1.0) as r:
-                    self.content_type = r.headers.get("Content-Type", "")
+                    ctype = r.headers.get("Content-Type", "")
                     body = r.read().decode()
-                if (len(self.metrics_bodies) < 200
-                        and (not self.metrics_bodies
-                             or self.metrics_bodies[-1][0] != code)):
-                    self.metrics_bodies.append((code, body))
-                self.metrics_bodies[-1] = (code, body)  # keep newest
+                with self._lock:
+                    self.content_type = ctype
+                    if (len(self.metrics_bodies) < 200
+                            and (not self.metrics_bodies
+                                 or self.metrics_bodies[-1][0] != code)):
+                        self.metrics_bodies.append((code, body))
+                    self.metrics_bodies[-1] = (code, body)  # keep newest
                 with urllib.request.urlopen(self.base + "/varz",
                                             timeout=1.0) as r:
-                    self.varz = json.load(r)
+                    varz = json.load(r)
+                with self._lock:
+                    self.varz = varz
             except (OSError, ValueError):
-                self.errors += 1
+                with self._lock:
+                    self.errors += 1
 
 
 def run_armed(out_dir: str) -> int:

@@ -159,9 +159,16 @@ class FaultRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # the registry's shared state: fault points fire from every
+        # io/trainer thread, so all four fields move only under the
+        # lock (checked statically - docs/STATIC_ANALYSIS.md GL016)
+        # guarded-by: self._lock
         self._faults: Dict[str, List[_Fault]] = {}
+        # guarded-by: self._lock
         self._env_faults: Dict[str, List[_Fault]] = {}
+        # guarded-by: self._lock
         self._hits: Dict[str, int] = {}
+        # guarded-by: self._lock
         self._env_seen: Optional[str] = None
 
     # -- configuration -----------------------------------------------------

@@ -1,0 +1,560 @@
+"""GL010-GL016 concurrency lint rules: per-rule true-positive and
+must-not-flag fixtures (docs/STATIC_ANALYSIS.md "Concurrency
+analysis"), in the test_graftlint.py style. The zero-unwaived
+acceptance over the shipped tree lives in test_graftlint.py and now
+covers these rules too.
+"""
+
+from cxxnet_tpu.analysis.astlint import (
+    CONCURRENCY_RULES, RULES, lint_file)
+
+
+def _lint(tmp_path, src, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return lint_file(str(p), name)
+
+
+def _rules(findings, waived=False):
+    return [f.rule for f in findings if f.waived == waived]
+
+
+def test_concurrency_rules_registered():
+    for rid in CONCURRENCY_RULES:
+        assert rid in RULES, rid
+
+
+# ---------------------------------------------------------------------------
+# GL010 bare-acquire
+# ---------------------------------------------------------------------------
+def test_gl010_bare_acquire_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+lock = threading.Lock()
+
+def f():
+    lock.acquire()
+    do_work()
+    lock.release()
+""")
+    assert _rules(fs) == ["GL010"]
+    assert "try/finally" in fs[0].message
+
+
+def test_gl010_with_statement_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+lock = threading.Lock()
+
+def f():
+    with lock:
+        do_work()
+""")
+    assert _rules(fs) == []
+
+
+def test_gl010_acquire_then_try_finally_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+lock = threading.Lock()
+
+def f():
+    lock.acquire()
+    try:
+        do_work()
+    finally:
+        lock.release()
+""")
+    assert _rules(fs) == []
+
+
+def test_gl010_acquire_inside_try_with_finally_release_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+lock = threading.Lock()
+
+def f():
+    try:
+        lock.acquire(timeout=1.0)
+        do_work()
+    finally:
+        lock.release()
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL011 thread-daemon-missing
+# ---------------------------------------------------------------------------
+def test_gl011_thread_without_daemon_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t
+""")
+    assert _rules(fs) == ["GL011"]
+
+
+def test_gl011_daemon_kwarg_and_late_attr_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+def spawn(fn):
+    a = threading.Thread(target=fn, daemon=True)
+    b = threading.Thread(target=fn)
+    b.daemon = False
+    a.start()
+    b.start()
+""")
+    assert _rules(fs) == []
+
+
+def test_gl011_thread_subclass_without_daemon_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Worker(threading.Thread):
+    def __init__(self, q):
+        super().__init__()
+        self.q = q
+""")
+    assert _rules(fs) == ["GL011"]
+    assert "Worker" in fs[0].message
+
+
+def test_gl011_thread_subclass_with_daemon_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Worker(threading.Thread):
+    def __init__(self, q):
+        super().__init__(daemon=True)
+        self.q = q
+
+class Other(threading.Thread):
+    def __init__(self):
+        super().__init__()
+        self.daemon = True
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL012 unlocked-thread-shared-write
+# ---------------------------------------------------------------------------
+def test_gl012_target_writes_self_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Poller:
+    def __init__(self):
+        self.result = None
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.result = compute()
+""")
+    assert _rules(fs) == ["GL012"]
+    assert "self.result" in fs[0].message
+
+
+def test_gl012_target_writes_global_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+state = 0
+
+def worker():
+    global state
+    state = 1
+
+t = threading.Thread(target=worker, daemon=True)
+""")
+    assert _rules(fs) == ["GL012"]
+
+
+def test_gl012_write_under_lock_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.result = None
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self.result = compute()
+""")
+    assert _rules(fs) == []
+
+
+def test_gl012_guarded_field_is_gl016s_job(tmp_path):
+    # an annotated field is exempt here; GL016 checks the discipline
+    fs = _lint(tmp_path, """
+import threading
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self.result = None
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.result = compute()
+""")
+    assert _rules(fs) == ["GL016"]
+
+
+def test_gl012_subclass_run_method_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Reader(threading.Thread):
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.exc = None
+
+    def run(self):
+        self.exc = read_all()
+""")
+    assert _rules(fs) == ["GL012"]
+
+
+def test_gl012_non_target_function_not_flagged(tmp_path):
+    # plain (main-thread) methods write instance state all the time
+    fs = _lint(tmp_path, """
+class Plain:
+    def configure(self):
+        self.state = 1
+""")
+    assert _rules(fs) == []
+
+
+def test_gl012_waivable(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Poller:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        # graftlint: disable=GL012 read only after stop+join (join is the happens-before)
+        self.result = compute()
+""")
+    assert _rules(fs) == []
+    assert _rules(fs, waived=True) == ["GL012"]
+
+
+# ---------------------------------------------------------------------------
+# GL013 join-no-timeout
+# ---------------------------------------------------------------------------
+def test_gl013_bare_join_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+def shutdown():
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join()
+""")
+    assert _rules(fs) == ["GL013"]
+
+
+def test_gl013_join_with_timeout_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class S:
+    def close(self):
+        self._thread.join(timeout=2.0)
+        for t in self._threads:
+            t.join(60.0)
+""")
+    assert _rules(fs) == []
+
+
+def test_gl013_str_join_and_os_path_join_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import os
+
+def render(parts, thread_names):
+    text = ", ".join(thread_names)
+    return os.path.join("a", text)
+""")
+    assert _rules(fs) == []
+
+
+def test_gl013_thread_collection_loop_var_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Pool:
+    def start(self):
+        self._threads = []
+        for i in range(4):
+            t = threading.Thread(target=work, daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def stop(self):
+        for t in self._threads:
+            t.join()
+""")
+    assert _rules(fs) == ["GL013"]
+
+
+# ---------------------------------------------------------------------------
+# GL014 condition-wait-no-predicate
+# ---------------------------------------------------------------------------
+def test_gl014_wait_outside_while_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def pop(self):
+        with self._cond:
+            self._cond.wait(0.1)
+            return self.items.pop()
+""")
+    assert _rules(fs) == ["GL014"]
+    assert "predicate" in fs[0].message
+
+
+def test_gl014_wait_inside_while_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def pop(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait(0.1)
+            return self.items.pop()
+""")
+    assert _rules(fs) == []
+
+
+def test_gl014_event_wait_and_wait_for_ok(tmp_path):
+    # Event.wait is level-triggered (no predicate needed); wait_for
+    # embeds the predicate loop
+    fs = _lint(tmp_path, """
+import threading
+
+class Q:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._cond = threading.Condition()
+
+    def run(self):
+        self._stop.wait(1.0)
+        with self._cond:
+            self._cond.wait_for(lambda: self.ready, timeout=1.0)
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL015 blocking-call-under-lock
+# ---------------------------------------------------------------------------
+def test_gl015_blocking_calls_under_lock_flag(tmp_path):
+    fs = _lint(tmp_path, """
+import queue
+import subprocess
+import threading
+import time
+
+lock = threading.Lock()
+q = queue.Queue()
+
+def drain(proc):
+    with lock:
+        item = q.get()
+        time.sleep(0.5)
+        subprocess.run(["make"])
+        proc.wait()
+    return item
+""")
+    assert _rules(fs) == ["GL015"] * 4
+
+
+def test_gl015_outside_lock_and_bounded_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import queue
+import subprocess
+import threading
+
+lock = threading.Lock()
+q = queue.Queue()
+
+def drain(proc):
+    item = q.get()
+    subprocess.run(["make"], timeout=60)
+    proc.wait(timeout=5)
+    with lock:
+        n = len(str(item))
+    return n
+""")
+    assert _rules(fs) == []
+
+
+def test_gl015_condition_wait_on_held_lock_ok(tmp_path):
+    # cond.wait RELEASES the held lock - the sanctioned pattern
+    fs = _lint(tmp_path, """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def pop(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait(0.05)
+            return self.items.pop()
+""")
+    assert _rules(fs) == []
+
+
+def test_gl015_nonblocking_get_under_lock_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import queue
+import threading
+
+lock = threading.Lock()
+q = queue.Queue()
+
+def drain():
+    with lock:
+        a = q.get_nowait()
+        b = q.get(False)
+    return a, b
+""")
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# GL016 guarded-by-violation
+# ---------------------------------------------------------------------------
+def test_gl016_write_outside_lock_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._state = {}
+
+    def reset(self):
+        self._state = {}
+""")
+    assert _rules(fs) == ["GL016"]
+    assert "guarded-by" in fs[0].message
+
+
+def test_gl016_write_under_lock_and_init_ok(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._state = {}
+
+    def set(self, k, v):
+        with self._lock:
+            self._state[k] = v
+
+    def reset(self):
+        with self._lock:
+            self._state = {}
+""")
+    assert _rules(fs) == []
+
+
+def test_gl016_other_base_needs_same_lock_attr(tmp_path):
+    # a module-level write through another base must hold THAT
+    # object's lock attribute (the reset_for_tests idiom)
+    fs = _lint(tmp_path, """
+import threading
+
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._state = {}
+
+REG = Reg()
+
+def good_reset():
+    with REG._lock:
+        REG._state = {}
+
+def bad_reset():
+    REG._state = {}
+""")
+    assert _rules(fs) == ["GL016"]
+    assert fs[0].line > 14  # the bad_reset write, not good_reset's
+
+
+def test_gl016_dangling_annotation_flags(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+# guarded-by: self._lock
+def not_an_attribute():
+    return 1
+""")
+    assert _rules(fs) == ["GL016"]
+    assert "matches no attribute" in fs[0].message
+
+
+def test_gl016_waivable(tmp_path):
+    fs = _lint(tmp_path, """
+import threading
+
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._state = {}
+
+    def reset_before_threads(self):
+        # graftlint: disable=GL016 called before any worker spawns
+        self._state = {}
+""")
+    assert _rules(fs) == []
+    assert _rules(fs, waived=True) == ["GL016"]
+
+
+# ---------------------------------------------------------------------------
+# first-party adoption: the annotated modules stay clean
+# ---------------------------------------------------------------------------
+def test_first_party_guarded_by_adoption():
+    import os
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    for rel in ("cxxnet_tpu/io/thread_util.py",
+                "cxxnet_tpu/utils/fault.py",
+                "cxxnet_tpu/serve/server.py",
+                "cxxnet_tpu/telemetry/__init__.py"):
+        path = os.path.join(repo, rel)
+        src = open(path).read()
+        assert "guarded-by:" in src, f"{rel} lost its annotations"
+        fs = lint_file(path, rel)
+        assert [f for f in fs if not f.waived
+                and f.rule in CONCURRENCY_RULES] == [], rel
