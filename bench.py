@@ -1012,6 +1012,144 @@ def _bench_serve(ctx) -> dict:
         return {"serve_error": f"{type(e).__name__}: {e}"}
 
 
+_BN_CONVNET_CONF = """
+netconfig=start
+layer[+1:c1] = conv:c1
+  nchannel = 24
+  kernel_size = 3
+  pad = 1
+layer[+1:b1] = batch_norm:b1
+layer[+1:r1] = relu
+layer[+1:p1] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[+1:c2] = conv:c2
+  nchannel = 32
+  kernel_size = 3
+  pad = 1
+layer[+1:b2] = batch_norm:b2
+layer[+1:r2] = relu
+layer[+1:p2] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[+1:fl] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 10
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,48,48
+eta = 0.1
+silent = 1
+seed = 19
+"""
+
+# fwd FLOP lower bound for the bn-convnet above: conv1 ~1.5M + conv2
+# ~4.0M MACs = ~11 MFLOP/img; deliberately the low end (an
+# under-estimate only loosens the physics cap, never flags a real
+# number)
+BN_CONVNET_FWD_GFLOP_PER_IMG = 0.01
+
+
+def _bench_fold(ctx) -> dict:
+    """Inference with the conv+bn folding graph pass
+    (graph_passes=fold_conv_bn,dead_layer_elim - nnet/passes.py,
+    docs/GRAPH_PASSES.md) vs the unfolded graph, on a bn-heavy
+    convnet (AlexNet has LRN, not BN, so the flagship can't carry
+    this field): the SAME predict_dist loop over the SAME images in
+    the same window, so the derived `fold_over_infer` prices exactly
+    what the fold removes - the per-batch moment/variance pipeline
+    and the normalize pass over every BN activation. >1.0 = folding
+    won; the fold leg calibrates once on the first batch (included
+    in warmup, not the timed window - warmup cost is the one-time
+    calibration executable). Two small compiles. Disable with
+    CXN_BENCH_FOLD=0."""
+    if os.environ.get("CXN_BENCH_FOLD") == "0":
+        return {}
+    try:
+        from cxxnet_tpu.io.data import DataBatch
+        from cxxnet_tpu.nnet.trainer import NetTrainer
+        from cxxnet_tpu.utils.config import parse_config_string
+        batch = ctx.batch
+
+        def build(extra=""):
+            tr = NetTrainer()
+            for k, v in parse_config_string(
+                    _BN_CONVNET_CONF + f"batch_size = {batch}\n"
+                    + extra):
+                tr.set_param(k, v)
+            tr.init_model()
+            return tr
+
+        rng = np.random.RandomState(31)
+        db = DataBatch(
+            data=rng.rand(batch, 3, 48, 48).astype(np.float32),
+            label=rng.randint(0, 10, (batch, 1)).astype(np.float32))
+
+        def ips_of(tr, budget_s=20.0):
+            tr.predict_dist(db)  # compile (+ fold calibration)
+            t0 = time.perf_counter()
+            tr.predict_dist(db)
+            per = max(time.perf_counter() - t0, 1e-6)
+            n = max(3, min(64, int(budget_s / per)))
+            t0 = time.perf_counter()
+            for _ in range(n):
+                tr.predict_dist(db)
+            return n * batch / (time.perf_counter() - t0), n
+
+        unfolded, n1 = ips_of(build())
+        folded, n2 = ips_of(build(
+            "graph_passes = fold_conv_bn,dead_layer_elim\n"))
+        out = {"fold_infer_ips": round(folded, 2),
+               "fold_unfolded_ips": round(unfolded, 2),
+               "fold_steps": n1 + n2}
+        if unfolded > 0:
+            out["fold_over_infer"] = round(folded / unfolded, 4)
+        return out
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"fold_error": f"{type(e).__name__}: {e}"}
+
+
+# the autotuner's default workload is the dispatch-bound tiny MLP
+# (tools/autotune.py): ~6k FLOP/img - the under-estimate convention
+AUTOTUNE_MLP_GFLOP_PER_IMG = 1e-5
+
+
+def _bench_autotune(ctx) -> dict:
+    """The TVM-style autotuner's own value proposition, measured:
+    run the bounded (steps_per_dispatch x prefetch_stage) search of
+    tools/autotune.py on its dispatch-bound default workload and
+    report the best cell (`autotune_best_ips`) against the shipped
+    defaults' cell in the SAME window (`tuned_over_default` - the
+    ratio a `tuning_cache =` pickup buys on this host). The serving
+    ladder is skipped here (the serve family already prices bucket
+    choice); the knob dict itself lands in `autotune_best` so a
+    bench artifact doubles as tuning evidence. Disable with
+    CXN_BENCH_AUTOTUNE=0; CXN_BENCH_AUTOTUNE_SECS bounds the search
+    (default 30)."""
+    if os.environ.get("CXN_BENCH_AUTOTUNE") == "0":
+        return {}
+    try:
+        from cxxnet_tpu.tools import autotune
+        from cxxnet_tpu.utils.config import parse_config_string
+        budget = float(os.environ.get("CXN_BENCH_AUTOTUNE_SECS",
+                                      "30"))
+        pairs = parse_config_string(autotune._DEFAULT_CONF)
+        res = autotune.search(pairs, budget, serve=False)
+        m = res["measured"]
+        out = {"autotune_best_ips": m["best_ips"],
+               "autotune_best": {k: v for k, v
+                                 in res["knobs"].items()},
+               "autotune_grid": m["grid"]}
+        if m.get("default_ips"):
+            out["autotune_default_ips"] = m["default_ips"]
+            out["tuned_over_default"] = round(
+                m["best_ips"] / m["default_ips"], 4)
+        return out
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"autotune_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_pool_ties(make, batch, steps, platform: str) -> dict:
     """Compute-path throughput with `pool_grad = ties` (the reference's
     tie-duplicating max-pool backward) vs the bench flagship's
@@ -1185,6 +1323,8 @@ _MEASUREMENTS = (
     ("fused", _bench_fused, "CXN_BENCH_FUSED", 150, "h2d"),
     ("zero", _bench_zero, "CXN_BENCH_ZERO", 150, "h2d"),
     ("serve", _bench_serve, "CXN_BENCH_SERVE", 150, "h2d"),
+    ("fold", _bench_fold, "CXN_BENCH_FOLD", 150, "h2d"),
+    ("autotune", _bench_autotune, "CXN_BENCH_AUTOTUNE", 150, "h2d"),
     ("attention",
      lambda c: _bench_attention(c.platform), "CXN_BENCH_ATTN", 100,
      "compute"),
@@ -1231,6 +1371,12 @@ _GFLOP_PER_IMG = {
     # direction; serve_rows_per_s carries the actual image rate
     "serve_rows_per_s": ALEXNET_TRAIN_GFLOP_PER_IMG / 3.0,
     "serve_qps": ALEXNET_TRAIN_GFLOP_PER_IMG / 3.0,
+    # fold/autotune run their own (small) workloads - per-workload
+    # fwd-FLOP lower bounds, same under-estimate convention
+    "fold_infer_ips": BN_CONVNET_FWD_GFLOP_PER_IMG,
+    "fold_unfolded_ips": BN_CONVNET_FWD_GFLOP_PER_IMG,
+    "autotune_best_ips": AUTOTUNE_MLP_GFLOP_PER_IMG,
+    "autotune_default_ips": AUTOTUNE_MLP_GFLOP_PER_IMG,
     "e2e_f32stage_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "device_augment_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_eval_train_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
@@ -1307,6 +1453,12 @@ def _derive(out: dict, batch: int, platform: str, ndev: int,
         # serve_over_predict is derived in-window by the serve child;
         # it must not outlive a physics-retracted serve_rows_per_s
         out.pop("serve_over_predict", None)
+    # same rule for the in-window pass/autotune ratios: a retracted
+    # base number takes its ratio with it
+    if not out.get("fold_infer_ips"):
+        out.pop("fold_over_infer", None)
+    if not out.get("autotune_best_ips"):
+        out.pop("tuned_over_default", None)
     if e2e:
         out["metric"] = "alexnet_b%d_%s_train_e2e" % (batch, platform)
         out["value"], out["value_is"] = e2e, "e2e"
@@ -1439,6 +1591,8 @@ _LAST_GOOD_PATH = os.path.join(_REPO, "docs", "last_good_tpu.json")
 _LAST_GOOD_MAX_FIELDS = (
     "compute_ips", "e2e_ips", "e2e_devicedata_ips", "e2e_prefetch_ips",
     "e2e_fused_ips", "zero2_ips", "serve_qps", "serve_rows_per_s",
+    "fold_infer_ips", "fold_over_infer",
+    "autotune_best_ips", "tuned_over_default",
     "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
     "resnet18_ips", "resnet18_devicedata_ips",
     "device_augment_ips", "chip_matmul_tflops", "attn_pallas_tflops",
@@ -1524,6 +1678,11 @@ _SYNC_SOURCE = {
     "zero2_ips": "zero",
     "serve_qps": "serve", "serve_rows_per_s": "serve",
     "serve_over_predict": "serve",
+    "fold_infer_ips": "fold", "fold_unfolded_ips": "fold",
+    "fold_over_infer": "fold",
+    "autotune_best_ips": "autotune",
+    "autotune_default_ips": "autotune",
+    "tuned_over_default": "autotune",
     "compute_poolties_ips": "pool_ties", "googlenet_ips": "googlenet",
     "googlenet_devicedata_ips": "googlenet",
     "resnet18_ips": "resnet18", "resnet18_devicedata_ips": "resnet18",

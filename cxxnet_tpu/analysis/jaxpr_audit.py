@@ -52,13 +52,27 @@ the jaxpr + StableHLO + compiled HLO:
   artifact checks with donation asserted ABSENT (a donated param
   would free the weights a concurrent replica still needs).
 
+- **pass-audit**: the graph-pass pipeline (nnet/passes.py,
+  docs/GRAPH_PASSES.md) audited at the traced-program level on a
+  fullc+batch_norm trainer with
+  `graph_passes = fold_conv_bn,dead_layer_elim`: the FOLDED
+  infer_step jaxpr contains no BN moment/variance pipeline (zero
+  rsqrt - the stats are frozen host constants - and strictly fewer
+  equations than the unfolded trace, which is asserted to contain
+  the rsqrt so the check cannot pass vacuously); the dead-layer-
+  eliminated early-node extract contains none of the pruned
+  subgraph's matmuls; and the fold adds ZERO new steady-state
+  executables - after the one-time calibration, repeated full+short
+  padded predicts and extracts leave every per-node infer cache at
+  exactly 1 (the recompile audit stays flat).
+
 Audited executables: `train_step`, `_train_chunk` (K=1 and K=4), the
 eval pair (`eval_step`, `eval_metric_step`) and the dedicated
 `infer_step` (predict/extract/serve share it), over the tiny-MLP
 config the fused-dispatch smoke uses, plus the zero-audit set
 (stage-2 `train_step`/`_train_chunk[K=4]` on `data:8`, stage-3
-`train_step` on `data:8`, stage-2 `train_step` on `data:4,model:2`)
-and the serve bucket set.
+`train_step` on `data:8`, stage-2 `train_step` on `data:4,model:2`),
+the serve bucket set and the pass-audit pair.
 Run under `JAX_PLATFORMS=cpu` in CI; the checks are artifact-level,
 so they hold for any backend that compiles the same programs.
 """
@@ -443,6 +457,94 @@ def _serve_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
     return {"serve_infer_warm": n_warm, "serve_infer_after": n_after}
 
 
+_CONF_BN = _CONF.replace(
+    "layer[+1:sg1] = tanh",
+    "layer[+1:bn1] = batch_norm:bn1\nlayer[+1:sg1] = tanh")
+
+
+def _traced(jitfn, args):
+    """(jaxpr_text, eqn_count, dot_count) of a jit's PRE-DCE trace -
+    the program the pass pipeline is responsible for (jax's own jit
+    DCE already prunes the LOWERED module, so lowered-size checks
+    would pass with the passes off; measured in pass_smoke)."""
+    tr = jitfn.trace(*args)
+    eqns = tr.jaxpr.jaxpr.eqns
+    return (str(tr.jaxpr), len(eqns),
+            sum(1 for e in eqns
+                if e.primitive.name == "dot_general"))
+
+
+def _pass_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Audit the graph-pass pipeline: build the BN trainer twice
+    (passes off / fold+dle on), calibrate the fold on a fixed batch,
+    and assert the docstring's pass-audit contract."""
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    def build(extra: str = ""):
+        tr = NetTrainer()
+        for k, v in parse_config_string(_CONF_BN + extra):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr
+
+    off = build()
+    on = build("graph_passes = fold_conv_bn,dead_layer_elim\n")
+    on.calibrate_graph_passes(_batch(0))
+    final = on.net_cfg.num_nodes - 1
+    early = on.net.node_index("fc1")
+    data = np.zeros((32, 1, 1, 36), np.float32)
+    gdata, gextras = on.stage_infer_rows(data)
+    fold_fn = on._infer_fn(final)
+    args_on = (on.state["params"], gdata, gextras)
+    gdo, geo = off.stage_infer_rows(data)
+    args_off = (off.state["params"], gdo, geo)
+    ftxt, feqns, fdots = _traced(fold_fn, args_on)
+    utxt, ueqns, udots = _traced(off._infer_fn(final), args_off)
+    checks.append(_check(
+        "passes/fold", "no-bn-moment-ops",
+        "rsqrt" not in ftxt and "rsqrt" in utxt,
+        f"folded rsqrt={ftxt.count('rsqrt')}, unfolded "
+        f"rsqrt={utxt.count('rsqrt')} (unfolded must carry it or "
+        "this check is vacuous)"))
+    checks.append(_check(
+        "passes/fold", "strictly-smaller-traced-program",
+        feqns < ueqns and fdots == udots,
+        f"folded {feqns} eqns/{fdots} dots vs unfolded {ueqns}/"
+        f"{udots} (fold removes the BN pipeline, never a matmul)"))
+    dtxt, deqns, ddots = _traced(on._infer_fn(early), args_on)
+    checks.append(_check(
+        "passes/dle", "pruned-subgraph-absent",
+        ddots == 1 and deqns < ueqns,
+        f"early-node extract traces {ddots} matmul(s)/{deqns} eqns "
+        f"(full graph: {udots}/{ueqns}) - the dead fc2/softmax tail "
+        "must not be traced"))
+    sizes: Dict[str, int] = {}
+    if _cache_size(fold_fn) is None:
+        checks.append(_check(
+            "passes", "cache-size-api", False,
+            "jit._cache_size unavailable on this jax version"))
+        return sizes
+    # steady state: full + padded-short predicts and repeated
+    # extracts add no executables past the per-shape compile
+    on.predict(_batch(70))
+    on.predict(_batch(71, b=20))
+    on.predict(_batch(72))
+    on.extract_feature(_batch(73), "fc1")
+    on.extract_feature(_batch(74, b=20), "fc1")
+    sizes["pass_infer_final"] = _cache_size(on._infer_fn(final))
+    sizes["pass_infer_early"] = _cache_size(on._infer_fn(early))
+    checks.append(_check(
+        "passes/fold", "zero-new-steady-state-executables",
+        sizes["pass_infer_final"] == 1
+        and sizes["pass_infer_early"] == 1,
+        f"final-node cache={sizes['pass_infer_final']}, early-node "
+        f"cache={sizes['pass_infer_early']} after full+short "
+        "predicts and extracts (want 1 each - padding keeps the "
+        "program shape static, folding adds nothing per dispatch)"))
+    return sizes
+
+
 def _recompile_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
     tr = _make_trainer()
     if _cache_size(tr._train_step) is None:
@@ -549,6 +651,7 @@ def run_audit() -> Dict[str, Any]:
     _zero_audit(checks)
     cache_sizes = _recompile_audit(checks)
     cache_sizes.update(_serve_audit(checks))
+    cache_sizes.update(_pass_audit(checks))
     return {
         "platform": jax.default_backend(),
         "jax_version": jax.__version__,

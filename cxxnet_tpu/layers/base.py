@@ -55,6 +55,11 @@ class LayerParam:
         self.silent = 0
         self.num_input_channel = 0
         self.num_input_node = 0
+        # per-layer compute-dtype pin consumed by the autocast graph
+        # pass (nnet/passes.py): overrides the policy for this layer
+        # ("" = follow the policy). Stored here so the config schema
+        # registry harvests the key.
+        self.layer_dtype = ""
 
     def set_param(self, name: str, val: str) -> None:
         if name == "init_sigma":
@@ -98,6 +103,12 @@ class LayerParam:
             self.no_bias = int(val)
         if name == "silent":
             self.silent = int(val)
+        if name == "layer_dtype":
+            if val not in ("", "float32", "bfloat16"):
+                raise ValueError(
+                    f"layer_dtype must be float32 or bfloat16, "
+                    f"got {val!r}")
+            self.layer_dtype = val
 
     def rand_init_weight(self, key: jax.Array, shape: Sequence[int],
                          in_num: int, out_num: int) -> jax.Array:

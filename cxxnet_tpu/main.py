@@ -107,6 +107,12 @@ class LearnTask:
         # error with a did-you-mean suggestion instead of silently
         # configuring nothing; schema_check = 0 bypasses
         self.schema_check = 1
+        # TVM-style per-platform tuning cache (nnet/tuning.py,
+        # tools/autotune.py, docs/GRAPH_PASSES.md): tuned values are
+        # DEFAULTS for the task-level knobs below (prefetch_stage,
+        # steps_per_dispatch) and the trainer's own tunables -
+        # explicitly-set config keys always win
+        self.tuning_cache = ""
         # task=serve load shape (docs/SERVING.md): rows per submitted
         # request when replaying the pred iterator through the server
         # (0 = a deterministic ragged size cycle, the bucket-coverage
@@ -177,6 +183,15 @@ class LearnTask:
             metrics_host=self.metrics_host,
             alert_rules=self.alert_rules, alert_cmd=self.alert_cmd,
             watchdog_secs=self.watchdog_secs)
+        if self.tuning_cache:
+            # AFTER the telemetry sinks armed (the apply_task event
+            # must reach the stream), BEFORE init() builds anything
+            # from the knobs; the trainer applies its own tunables
+            # from the same cache (the `tuning_cache` pair reaches it
+            # with the rest of the config) under the same
+            # explicit-keys-win rule - so the two consumers can never
+            # disagree on a shared knob like steps_per_dispatch
+            self._apply_tuning_cache()
         telemetry.event("run_start", task=self.task, conf=argv[0],
                         num_round=self.num_round)
         t_run = time.monotonic()
@@ -277,7 +292,35 @@ class LearnTask:
             self.schema_check = int(val)
         if name == "serve_rows":
             self.serve_rows = int(val)
+        if name == "tuning_cache":
+            self.tuning_cache = val
         self.cfg.append((name, val))
+
+    def _apply_tuning_cache(self) -> None:
+        """Apply tuned task-level knob defaults from `tuning_cache =`
+        (nnet/tuning.py): only knobs no config pair set explicitly.
+        A cache with no entry for this platform applies nothing."""
+        from cxxnet_tpu.nnet import tuning
+        knobs = tuning.tuned_knobs(self.tuning_cache)
+        explicit = {k for k, _ in self.cfg}
+        applied = {}
+        # tuning.int_knob is THE shared apply rule (explicit keys
+        # win, malformed values skip) - the trainer consumes the same
+        # cache through the same helper
+        v = tuning.int_knob(knobs, "prefetch_stage", explicit, 0)
+        if v is not None:
+            self.prefetch_stage = applied["prefetch_stage"] = v
+        v = tuning.int_knob(knobs, "steps_per_dispatch", explicit, 1)
+        if v is not None:
+            self.steps_per_dispatch = applied["steps_per_dispatch"] = v
+        if applied and not self.silent:
+            telemetry.stdout(
+                "tuning_cache: applied "
+                + " ".join(f"{k}={v}"
+                           for k, v in sorted(applied.items())))
+        if applied:
+            telemetry.event("tuning", op="apply_task",
+                            cache=self.tuning_cache, **applied)
 
     # ------------------------------------------------------------------
     def _split_blocks(self):
@@ -866,6 +909,18 @@ class LearnTask:
             "must specify a predict iterator to drive task = serve"
         import numpy as np
         from cxxnet_tpu.serve import Server, predictions_from_rows
+        if self.net_trainer.passes_need_calibration():
+            # fold_conv_bn needs statistics BEFORE the bucket
+            # executables compile (they are frozen per Server): use
+            # the first pred batch - the same source the predict
+            # path calibrates from (docs/GRAPH_PASSES.md)
+            self.itr_pred.before_first()
+            if self.itr_pred.next():
+                self.net_trainer.calibrate_graph_passes(
+                    self.itr_pred.value())
+                telemetry.stdout(
+                    "serve: calibrated graph passes on the first "
+                    "pred batch")
         srv = Server(self.net_trainer)
         telemetry.stdout(
             f"serve: warming {len(srv.buckets)} bucket executables "

@@ -41,6 +41,10 @@ class Network:
         self.batch_size = batch_size
         self.layer_objs: List[Layer] = []
         self.node_shapes: List[Optional[Shape]] = [None] * cfg.num_nodes
+        # per-layer compute-dtype plan stamped by the autocast graph
+        # pass (nnet/passes.py); None = no plan, historic behavior
+        # (the trainer casts wholesale to its compute dtype)
+        self.dtype_plan: Optional[Dict[int, jnp.dtype]] = None
 
         # node 0 is the data input; in_1..in_k are extra data
         c, y, x = cfg.input_shape
@@ -126,8 +130,16 @@ class Network:
         rng: Optional[jax.Array] = None,
         labels: Optional[Dict[str, jax.Array]] = None,
         mask: Optional[jax.Array] = None,
+        taps: Optional[Dict[int, Optional[jax.Array]]] = None,
     ) -> Tuple[List[jax.Array], jax.Array]:
         """Run all connections in declaration order.
+
+        taps: optional {layer_index: None} dict, filled in place with
+        each listed layer's (first) INPUT as that layer receives it -
+        i.e. BEFORE a self-loop layer overwrites its node. The fold
+        calibration (trainer._calibrate_staged) needs the batch_norm
+        input, and reading `values[node]` after the forward would see
+        the post-BN value for `layer[+0] = batch_norm` self-loops.
 
         inputs: node index -> array (node 0 data + extra-data nodes).
         labels: label field name -> (b, width) array; required when any
@@ -153,6 +165,24 @@ class Network:
                 cfg, info.primary_layer_index if info.is_shared else idx)
             p = params.get(pkey, {})
             xs = [values[j] for j in info.nindex_in]
+            if self.dtype_plan is not None:
+                want = self.dtype_plan.get(idx)
+                if want is not None:
+                    # autocast plan (nnet/passes.py): cast this
+                    # layer's inputs + params to its stamped compute
+                    # dtype; f32-stamped layers under a bf16 net thus
+                    # run their math in f32 (the next bf16 layer
+                    # casts back down)
+                    xs = [x.astype(want)
+                          if jnp.issubdtype(x.dtype, jnp.floating)
+                          else x for x in xs]
+                    p = {k: (v.astype(want)
+                             if jnp.issubdtype(v.dtype, jnp.floating)
+                             else v) for k, v in p.items()}
+            if taps is not None and idx in taps:
+                # post-cast snapshot: exactly what the layer's apply
+                # receives (the docstring's tap contract)
+                taps[idx] = xs[0]
             layer_rng = (jax.random.fold_in(rng, idx)
                          if rng is not None else None)
 

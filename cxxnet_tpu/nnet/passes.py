@@ -1,0 +1,565 @@
+"""Graph-level optimizing passes over the NetConfig DAG.
+
+NetConfig already parses configs into a layer DAG; this module treats
+that DAG as an IR with Relay-style optimizing passes (PAPERS.md:
+arXiv:1810.00952) run by the trainer before the Network is built -
+`PassPipeline` of named `GraphPass`es over a shared pattern-rewrite
+engine (docs/GRAPH_PASSES.md). Shipped passes:
+
+- **space_to_depth** (graph stage): the input-conv space-to-depth
+  rewrite, previously an auto heuristic buried inside `ops.conv2d`,
+  re-expressed as a pattern rewrite: the pass evaluates the SAME
+  predicate (`ops.conv.s2d_auto` - one definition, so the pass and
+  the op cannot disagree) against the inferred node shapes and stamps
+  an explicit `space_to_depth = 0|1` onto each conv's layer config.
+  An explicit per-layer `space_to_depth` always wins.
+- **autocast** (graph stage): the bf16/f32 mixed-precision policy as
+  ONE pass instead of per-layer flags: under `dtype = bfloat16` it
+  stamps a compute dtype per layer (`GraphModule.dtype_plan`,
+  consumed by `Network.forward`) - matmul/conv-heavy layers run
+  bf16, numerically fragile layers (batch_norm, lrn, the loss heads)
+  stay f32. The existing flags become overrides: `dtype` sets the
+  policy, a per-layer `layer_dtype = float32|bfloat16` pins a layer.
+- **dead_layer_elim** (infer stage): prune every layer not on a path
+  to the requested output node - the extract/finetune/serve subgraph.
+  jax's jit DCEs the *lowered* module already (measured: the compiled
+  HLO of an early-node infer is byte-identical with or without the
+  dead tail), so the honest wins are the traced program (strictly
+  fewer jaxpr equations), trace/lowering latency, and keeping the
+  fold pass's pattern space small. Kept `share[...]` layers whose
+  primary is pruned are promoted to primaries (their params arrive
+  via the param map, so no dead ancestor is retained).
+- **fold_conv_bn** (infer stage): fold a batch_norm following a conv
+  or fullc into that layer's weights/bias so the donation-free
+  `infer_step` executes a single fused matmul/conv with NO moment or
+  variance computation. This repo's BN normalizes with *minibatch*
+  statistics even at eval (reference quirk), so the fold freezes the
+  statistics captured from ONE calibration batch (the trainer's
+  first inference batch, or an explicit
+  `trainer.calibrate_graph_passes(batch)`); `rsqrt(var + eps)` is
+  precomputed on the host so the folded jaxpr carries no rsqrt
+  either. The folded weights stay a LIVE function of the params
+  argument (`W' = W * slope * rstd` inside the jit), so a
+  checkpoint load or set_weight is picked up without re-folding;
+  only the frozen statistics are calibration-time constants.
+  Semantics note (docs/GRAPH_PASSES.md "when folding loses"):
+  frozen stats make inference batch-composition-INDEPENDENT - for
+  serving that is a correctness win (a request's answer no longer
+  depends on what else was coalesced into its bucket); parity with
+  the unfolded path is exact (~ULP contraction change) when the
+  calibration batch IS the inference batch and approximate
+  otherwise.
+
+Passes never touch the training graph structure or the checkpoint
+format: graph-stage passes only stamp layer configs / dtype
+annotations (NetConfig.to_dict is structure-only), and infer-stage
+passes run on a clone consumed solely by the inference executables.
+
+On top, the TVM-style tuning cache (arXiv:1802.04799) lives in
+`nnet/tuning.py` and `tools/autotune.py`.
+"""
+
+from __future__ import annotations
+
+import difflib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from cxxnet_tpu.nnet.net_config import NetConfig
+
+# layer types whose math is one big contraction - the autocast
+# policy's bf16 set is "everything except the fragile ones", this set
+# only documents the headline beneficiaries
+_F32_SENSITIVE_TYPES = frozenset((
+    "batch_norm", "lrn", "softmax", "l2_loss", "multi_logistic"))
+
+# fold pattern: the producing layer types a batch_norm folds into
+_FOLDABLE_TYPES = frozenset(("conv", "fullc"))
+
+
+# ---------------------------------------------------------------------------
+# the IR the passes transform
+# ---------------------------------------------------------------------------
+@dataclass
+class FoldSite:
+    """One folded conv/fullc + batch_norm pair: the live-params keys
+    of both layers plus the frozen per-channel calibration statistics
+    (mean of the BN input, rsqrt(var + eps))."""
+
+    conv_key: str
+    bn_key: str
+    mean: np.ndarray
+    rstd: np.ndarray
+
+
+@dataclass
+class GraphModule:
+    """A NetConfig DAG in flight through the pass pipeline.
+
+    `param_keys[i]` is the LIVE params-pytree key layer i's weights
+    come from (None for param-less or shared layers) - structural
+    passes keep it aligned so `make_param_fn` can rebuild the
+    transformed graph's params from the live train params no matter
+    how indices shifted."""
+
+    cfg: NetConfig
+    batch_size: int
+    compute_dtype: Any = None
+    param_keys: List[Optional[str]] = field(default_factory=list)
+    folds: List[FoldSite] = field(default_factory=list)
+    dtype_plan: Dict[int, Any] = field(default_factory=dict)
+    log: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_net_config(cls, cfg: NetConfig, batch_size: int,
+                        compute_dtype: Any = None) -> "GraphModule":
+        from cxxnet_tpu.nnet.network import param_key
+        keys: List[Optional[str]] = []
+        for idx, info in enumerate(cfg.layers):
+            keys.append(None if info.is_shared
+                        else param_key(cfg, idx))
+        return cls(cfg=cfg, batch_size=batch_size,
+                   compute_dtype=compute_dtype, param_keys=keys)
+
+    # -- structural edits -------------------------------------------------
+    def remove_layers(self, indices: Sequence[int]) -> None:
+        """Drop layers by index, remapping share back-references and
+        keeping layercfg/param_keys/dtype_plan aligned."""
+        drop = set(indices)
+        if not drop:
+            return
+        cfg = self.cfg
+        remap: Dict[int, int] = {}
+        for old in range(len(cfg.layers)):
+            if old not in drop:
+                remap[old] = len(remap)
+        for old in drop:
+            info = cfg.layers[old]
+            if any(li.primary_layer_index == old
+                   for i, li in enumerate(cfg.layers)
+                   if i not in drop and li.is_shared):
+                raise ValueError(
+                    f"cannot remove layer {old} "
+                    f"({info.type_name}): a kept share[...] layer "
+                    "references it as primary")
+        cfg.layers = [li for i, li in enumerate(cfg.layers)
+                      if i not in drop]
+        cfg.layercfg = [c for i, c in enumerate(cfg.layercfg)
+                        if i not in drop]
+        self.param_keys = [k for i, k in enumerate(self.param_keys)
+                           if i not in drop]
+        self.dtype_plan = {remap[i]: d for i, d in
+                           self.dtype_plan.items() if i in remap}
+        for li in cfg.layers:
+            if li.is_shared:
+                li.primary_layer_index = remap[li.primary_layer_index]
+        cfg.layer_name_map = {
+            li.name: i for i, li in enumerate(cfg.layers)
+            if li.name and not li.is_shared}
+
+    def param_map(self) -> Dict[str, str]:
+        """Transformed-graph param key -> live-params key."""
+        from cxxnet_tpu.nnet.network import param_key
+        out: Dict[str, str] = {}
+        for idx, info in enumerate(self.cfg.layers):
+            if info.is_shared or self.param_keys[idx] is None:
+                continue
+            out[param_key(self.cfg, idx)] = self.param_keys[idx]
+        return out
+
+
+@dataclass
+class PassContext:
+    """Per-run inputs the passes read (never mutate)."""
+
+    #: requested output node for infer-stage passes (None = train
+    #: graph, where only graph-stage passes apply)
+    target_node: Optional[int] = None
+    #: bn live-params key -> (mean, rstd) calibration stats; None =
+    #: not calibrated yet (fold defers)
+    fold_stats: Optional[Dict[str, Tuple[np.ndarray, np.ndarray]]] = None
+
+
+# ---------------------------------------------------------------------------
+# pattern-rewrite engine: DAG queries shared by every pass
+# ---------------------------------------------------------------------------
+def node_consumers(cfg: NetConfig) -> Dict[int, List[int]]:
+    """node index -> layer indices reading it (declaration order)."""
+    cons: Dict[int, List[int]] = {}
+    for idx, info in enumerate(cfg.layers):
+        for j in info.nindex_in:
+            cons.setdefault(j, []).append(idx)
+    return cons
+
+
+def share_primaries(cfg: NetConfig) -> set:
+    """Layer indices that are the primary of some share[...] layer."""
+    return {li.primary_layer_index for li in cfg.layers if li.is_shared}
+
+
+def find_fold_sites(cfg: NetConfig) -> List[Tuple[int, int]]:
+    """(producer_idx, bn_idx) pairs matching the fold pattern: a
+    non-shared conv/fullc whose single output node feeds EXACTLY one
+    batch_norm (self-loop BN allowed - later readers then see the
+    post-BN value, which the folded layer reproduces). Weight-shared
+    layers are excluded on both sides: folding a shared weight would
+    specialize it per site."""
+    sites: List[Tuple[int, int]] = []
+    primaries = share_primaries(cfg)
+    cons = node_consumers(cfg)
+    for j, bn in enumerate(cfg.layers):
+        if (bn.type_name != "batch_norm" or bn.is_shared
+                or j in primaries):
+            continue
+        if len(bn.nindex_in) != 1 or len(bn.nindex_out) != 1:
+            continue
+        a = bn.nindex_in[0]
+        writers = [i for i, li in enumerate(cfg.layers)
+                   if a in li.nindex_out and i != j]
+        if len(writers) != 1:
+            continue
+        i = writers[0]
+        conv = cfg.layers[i]
+        if (i > j or conv.type_name not in _FOLDABLE_TYPES
+                or conv.is_shared or i in primaries):
+            continue
+        if len(conv.nindex_out) != 1 or conv.nindex_out[0] != a:
+            continue
+        readers = [c for c in cons.get(a, ()) if c != j]
+        if bn.nindex_out[0] == a:
+            # self-loop BN overwrites a: only a reader BETWEEN the
+            # conv and the bn would see the raw conv output
+            if any(i < c < j for c in readers):
+                continue
+        elif readers:
+            continue
+        sites.append((i, j))
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# passes
+# ---------------------------------------------------------------------------
+class GraphPass:
+    """One named transform over a GraphModule. `stage` declares when
+    it runs: "graph" passes apply to the train+eval network at build
+    time and must preserve values and checkpoint structure; "infer"
+    passes apply per requested output node to the clone the inference
+    executables are built from."""
+
+    name: str = ""
+    stage: str = "graph"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        raise NotImplementedError
+
+
+PASS_REGISTRY: Dict[str, Type[GraphPass]] = {}
+
+# canonical application order (infer passes prune first so the fold
+# never sees - or folds - a dead subgraph)
+_CANONICAL_ORDER = ("space_to_depth", "autocast",
+                    "dead_layer_elim", "fold_conv_bn")
+
+
+def register_pass(cls: Type[GraphPass]) -> Type[GraphPass]:
+    assert cls.name, "pass class must define a name"
+    PASS_REGISTRY[cls.name] = cls
+    return cls
+
+
+def resolve_pass_name(name: str) -> str:
+    """Validate a pass name with did-you-mean (the `serve_max_batchh`
+    precedent applied to pass names: a typo'd pass must cost an error
+    with a suggestion, never a silently-unoptimized run)."""
+    if name in PASS_REGISTRY:
+        return name
+    hint = difflib.get_close_matches(name, PASS_REGISTRY.keys(), n=1,
+                                     cutoff=0.6)
+    msg = f"unknown graph pass '{name}'"
+    if hint:
+        msg += f" (did you mean '{hint[0]}'?)"
+    raise ValueError(
+        msg + f"; available passes: {', '.join(sorted(PASS_REGISTRY))}")
+
+
+@register_pass
+class SpaceToDepthPass(GraphPass):
+    """Stamp the space-to-depth input-conv rewrite decision onto the
+    DAG (module docstring). Value-identical to the in-op auto
+    heuristic by construction: both evaluate `ops.conv.s2d_auto`."""
+
+    name = "space_to_depth"
+    stage = "graph"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        from cxxnet_tpu.ops.conv import s2d_auto
+
+        def unstamped(idx, info):
+            return (info.type_name == "conv" and not info.is_shared
+                    and not any(k == "space_to_depth"
+                                for k, _ in (gm.cfg.defcfg
+                                             + gm.cfg.layercfg[idx])))
+
+        if not any(unstamped(i, li)
+                   for i, li in enumerate(gm.cfg.layers)):
+            # nothing to stamp: skip the shape-inference Network
+            # build entirely (the common MLP/no-conv case)
+            return gm
+        from cxxnet_tpu.nnet.network import Network
+        net = Network(gm.cfg, gm.batch_size)
+        for idx, info in enumerate(gm.cfg.layers):
+            if not unstamped(idx, info):
+                continue
+            lay = net.layer_objs[idx]
+            in_ch = net.node_shapes[info.nindex_in[0]][1]
+            on = s2d_auto(in_ch, lay.param.stride,
+                          lay.param.kernel_height,
+                          lay.param.kernel_width, lay.param.num_group)
+            gm.cfg.layercfg[idx].append(
+                ("space_to_depth", "1" if on else "0"))
+            gm.log.append(
+                f"space_to_depth: conv[{idx}] in_ch={in_ch} "
+                f"stride={lay.param.stride} -> {int(on)}")
+        return gm
+
+
+@register_pass
+class AutocastPass(GraphPass):
+    """Stamp a compute dtype per layer (module docstring). A no-op
+    under f32 compute; under bf16 the fragile layer types stay f32
+    and `layer_dtype = float32|bfloat16` pins individual layers."""
+
+    name = "autocast"
+    stage = "graph"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        import jax.numpy as jnp
+        if gm.compute_dtype is None or gm.compute_dtype == jnp.float32:
+            gm.log.append("autocast: f32 compute, nothing to stamp")
+            return gm
+        parse = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+        for idx, info in enumerate(gm.cfg.layers):
+            src = (info.primary_layer_index if info.is_shared else idx)
+            ltype = gm.cfg.layers[src].type_name
+            override = ""
+            for k, v in gm.cfg.defcfg + gm.cfg.layercfg[src]:
+                if k == "layer_dtype":
+                    override = v
+            if override:
+                if override not in parse:
+                    raise ValueError(
+                        "layer_dtype must be float32 or bfloat16, "
+                        f"got {override!r}")
+                d = parse[override]
+            elif ltype in _F32_SENSITIVE_TYPES:
+                d = jnp.float32
+            else:
+                d = gm.compute_dtype
+            gm.dtype_plan[idx] = d
+            gm.log.append(f"autocast: layer[{idx}] {ltype} -> "
+                          f"{jnp.dtype(d).name}")
+        return gm
+
+
+@register_pass
+class DeadLayerElimPass(GraphPass):
+    """Prune layers not on a path to the requested output node
+    (module docstring)."""
+
+    name = "dead_layer_elim"
+    stage = "infer"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        if ctx.target_node is None:
+            return gm
+        cfg = gm.cfg
+        needed = {ctx.target_node}
+        keep: set = set()
+        for idx in reversed(range(len(cfg.layers))):
+            info = cfg.layers[idx]
+            if any(o in needed for o in info.nindex_out):
+                keep.add(idx)
+                needed.update(info.nindex_in)
+        if ctx.target_node >= cfg.num_nodes:
+            raise ValueError(
+                f"dead_layer_elim: unknown target node "
+                f"{ctx.target_node}")
+        # kept share layers whose primary died: promote to primary -
+        # the weights arrive through the param map, so the dead
+        # ancestor chain need not be retained for them
+        for idx in sorted(keep):
+            info = cfg.layers[idx]
+            if not info.is_shared:
+                continue
+            prim = info.primary_layer_index
+            if prim in keep:
+                continue
+            primary = cfg.layers[prim]
+            info.type_name = primary.type_name
+            info.primary_layer_index = -1
+            info.name = ""
+            cfg.layercfg[idx] = list(cfg.layercfg[prim])
+            gm.param_keys[idx] = gm.param_keys[prim]
+            gm.log.append(
+                f"dead_layer_elim: promoted share[{idx}] to primary "
+                f"(its primary {prim} is dead)")
+        dropped = [i for i in range(len(cfg.layers)) if i not in keep]
+        if dropped:
+            gm.log.append(
+                f"dead_layer_elim: pruned {len(dropped)}/"
+                f"{len(cfg.layers)} layers not reaching node "
+                f"{ctx.target_node}")
+        gm.remove_layers(dropped)
+        return gm
+
+
+@register_pass
+class FoldConvBNPass(GraphPass):
+    """Fold conv/fullc + batch_norm chains using frozen calibration
+    statistics (module docstring). Defers (logs, no rewrite) until
+    `ctx.fold_stats` exists; skips any site whose raw pre-BN value is
+    the requested output."""
+
+    name = "fold_conv_bn"
+    stage = "infer"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        sites = find_fold_sites(gm.cfg)
+        if not sites:
+            return gm
+        if ctx.fold_stats is None:
+            gm.log.append(
+                f"fold_conv_bn: {len(sites)} site(s) deferred - no "
+                "calibration stats yet")
+            return gm
+        drop: List[int] = []
+        for i, j in sites:
+            conv, bn = gm.cfg.layers[i], gm.cfg.layers[j]
+            bn_key, conv_key = gm.param_keys[j], gm.param_keys[i]
+            stats = ctx.fold_stats.get(bn_key)
+            if stats is None:
+                gm.log.append(
+                    f"fold_conv_bn: no stats for {bn_key}, skipped")
+                continue
+            if (bn.nindex_out[0] != bn.nindex_in[0]
+                    and bn.nindex_in[0] == ctx.target_node):
+                # the caller asked for the RAW conv output
+                gm.log.append(
+                    f"fold_conv_bn: target node is {conv_key}'s raw "
+                    "output, site skipped")
+                continue
+            conv.nindex_out = list(bn.nindex_out)
+            gm.folds.append(FoldSite(conv_key=conv_key, bn_key=bn_key,
+                                     mean=stats[0], rstd=stats[1]))
+            drop.append(j)
+            gm.log.append(
+                f"fold_conv_bn: folded {bn_key} into {conv_key}")
+        gm.remove_layers(drop)
+        return gm
+
+
+# ---------------------------------------------------------------------------
+# params of a transformed graph, from the live train params
+# ---------------------------------------------------------------------------
+def make_param_fn(gm: GraphModule):
+    """jax-traceable function: live train params -> the transformed
+    graph's params. Key remaps are free; fold sites compute
+    `W' = W * (slope * rstd)` and `b' = (b - mean) * k + beta` from
+    the LIVE weights (the folded weights track checkpoint loads and
+    set_weight), with only mean/rstd frozen at calibration - and
+    rstd precomputed, so no rsqrt (let alone a moment reduction)
+    appears in the folded jaxpr."""
+    import jax.numpy as jnp
+    pairs = list(gm.param_map().items())
+    fold_by_key = {s.conv_key: s for s in gm.folds}
+
+    def param_fn(params):
+        out = {}
+        for new_key, live_key in pairs:
+            if live_key not in params:
+                continue
+            site = fold_by_key.get(live_key)
+            if site is None:
+                out[new_key] = params[live_key]
+                continue
+            conv_p, bn_p = params[live_key], params[site.bn_key]
+            k = bn_p["slope"] * jnp.asarray(site.rstd)
+            w = conv_p["wmat"]
+            kw = k.reshape((-1,) + (1,) * (w.ndim - 1))
+            bias = conv_p.get("bias", jnp.zeros_like(k))
+            out[new_key] = {
+                "wmat": w * kw.astype(w.dtype),
+                "bias": (bias - jnp.asarray(site.mean)) * k
+                        + bn_p["bias"],
+            }
+        return out
+
+    return param_fn
+
+
+# ---------------------------------------------------------------------------
+# the pipeline
+# ---------------------------------------------------------------------------
+class PassPipeline:
+    """An ordered set of GraphPasses (canonical order, module
+    docstring). Built from the `graph_passes = a,b,...` config key
+    plus the per-pass `pass_<name> = 0|1` toggles; unknown names get
+    did-you-mean errors."""
+
+    def __init__(self, passes: Sequence[GraphPass]):
+        order = {n: i for i, n in enumerate(_CANONICAL_ORDER)}
+        self.passes = sorted(passes,
+                             key=lambda p: order.get(p.name, 99))
+
+    @classmethod
+    def from_config(cls, spec: str,
+                    toggles: Optional[Dict[str, int]] = None,
+                    ) -> "PassPipeline":
+        spec = (spec or "").strip()
+        if spec in ("0", "none", "off"):
+            spec = ""
+        if spec == "all":
+            # every REGISTERED pass - not the canonical-order tuple,
+            # which only sorts: a pass added via @register_pass must
+            # not be silently excluded from `graph_passes = all`
+            enabled = set(PASS_REGISTRY)
+        else:
+            enabled = {resolve_pass_name(t.strip())
+                       for t in spec.split(",") if t.strip()}
+        for name, on in (toggles or {}).items():
+            resolve_pass_name(name)
+            if on:
+                enabled.add(name)
+            else:
+                enabled.discard(name)
+        return cls([PASS_REGISTRY[n]() for n in enabled])
+
+    @property
+    def graph_passes(self) -> List[GraphPass]:
+        return [p for p in self.passes if p.stage == "graph"]
+
+    @property
+    def infer_passes(self) -> List[GraphPass]:
+        return [p for p in self.passes if p.stage == "infer"]
+
+    def has(self, name: str) -> bool:
+        return any(p.name == name for p in self.passes)
+
+    def run_graph(self, gm: GraphModule,
+                  ctx: Optional[PassContext] = None) -> GraphModule:
+        ctx = ctx or PassContext()
+        for p in self.graph_passes:
+            gm = p.run(gm, ctx)
+        return gm
+
+    def run_infer(self, gm: GraphModule,
+                  ctx: PassContext) -> GraphModule:
+        for p in self.infer_passes:
+            gm = p.run(gm, ctx)
+        return gm
+
+    def names(self) -> List[str]:
+        return [p.name for p in self.passes]

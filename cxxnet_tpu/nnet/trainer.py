@@ -170,6 +170,29 @@ class NetTrainer:
         self.serve_max_batch = 0
         self.serve_max_wait_ms = 2.0
         self.serve_replicas = 1
+        # graph-level optimizing passes over the NetConfig DAG
+        # (nnet/passes.py, docs/GRAPH_PASSES.md): comma list of pass
+        # names ("" = off, "all" = every registered pass) plus
+        # per-pass `pass_<name> = 0|1` toggles. Graph-stage passes
+        # (space_to_depth stamp, autocast plan) apply to the built
+        # network; infer-stage passes (dead_layer_elim, fold_conv_bn)
+        # apply only to the clone the inference executables compile
+        # from - training trajectories and checkpoints are untouched
+        self.graph_passes = ""
+        self._pass_toggles: Dict[str, int] = {}
+        self._pipeline = None
+        self._graph_dtype_plan = None
+        # fold_conv_bn calibration state: bn param key -> (mean,
+        # rstd) frozen at calibration; epoch keys the per-node infer
+        # executable cache so a recalibration rebuilds cleanly
+        self._fold_stats: Optional[Dict[str, Any]] = None
+        self._fold_epoch = 0
+        self._infer_graph_cache: Dict[Any, Any] = {}
+        # TVM-style tuning cache (nnet/tuning.py, tools/autotune.py):
+        # tuned knob values are DEFAULTS - explicitly-set config keys
+        # always win (tracked per key at set_param time)
+        self.tuning_cache = ""
+        self._explicit_tunables: set = set()
         self.profile = 0
         self.profile_dir = ""
         self.trace_round = 1
@@ -271,6 +294,20 @@ class NetTrainer:
             if int(val) < 1:
                 raise ValueError("serve_replicas must be >= 1")
             self.serve_replicas = int(val)
+        if name == "graph_passes":
+            self.graph_passes = val
+        if name.startswith("pass_"):
+            # per-pass toggles layered over graph_passes (membership
+            # add/remove): prefix-form so a new @register_pass needs
+            # no handler edit here; the name is validated against the
+            # pass registry at _build_net with did-you-mean
+            self._pass_toggles[name[len("pass_"):]] = int(val)
+        if name == "tuning_cache":
+            self.tuning_cache = val
+        if name in ("steps_per_dispatch", "serve_max_batch",
+                    "stage_dtype"):
+            # explicit config keys beat tuning-cache defaults
+            self._explicit_tunables.add(name)
         if name == "profile":
             self.profile = int(val)
         if name == "profile_dir":
@@ -366,7 +403,39 @@ class NetTrainer:
     def _build_net(self) -> None:
         if self.batch_size <= 0:
             raise ValueError("batch_size must be set")
+        self._apply_tuning_cache()
+        # graph-pass pipeline (nnet/passes.py): graph-stage passes
+        # stamp the live NetConfig (layer configs / dtype plan only -
+        # structure, and with it the checkpoint format, is untouched);
+        # infer-stage passes run lazily per requested node in
+        # _build_infer_graph. An empty graph_passes config builds an
+        # empty pipeline and every path below is byte-identical to
+        # the pass-less trainer.
+        from cxxnet_tpu.nnet.passes import (
+            GraphModule, PassPipeline)
+        self._pipeline = PassPipeline.from_config(self.graph_passes,
+                                                  self._pass_toggles)
+        self._graph_dtype_plan = None
+        self._fold_stats = None
+        self._fold_epoch = 0
+        self._infer_graph_cache = {}
+        # fold sites depend only on the graph structure: matched ONCE
+        # here, not per inference batch (passes_need_calibration sits
+        # on the predict hot path)
+        from cxxnet_tpu.nnet.passes import find_fold_sites
+        self._fold_sites = (find_fold_sites(self.net_cfg)
+                            if self._pipeline.has("fold_conv_bn")
+                            else [])
+        if self._pipeline.graph_passes:
+            gm = GraphModule.from_net_config(
+                self.net_cfg, self.batch_size, self.compute_dtype)
+            gm = self._pipeline.run_graph(gm)
+            self._graph_dtype_plan = gm.dtype_plan or None
+            if not self.silent and gm.log:
+                for line in gm.log:
+                    telemetry.stdout(f"graph_passes: {line}")
         self.net = Network(self.net_cfg, self.batch_size)
+        self.net.dtype_plan = self._graph_dtype_plan
         if not self.silent:
             for i, s in enumerate(self.net.node_shapes):
                 telemetry.stdout(
@@ -426,6 +495,18 @@ class NetTrainer:
                                                            **kwargs)
 
     def _init_state(self, params) -> None:
+        # params changed: any frozen fold statistics describe the OLD
+        # activations - drop them AND retire the executables compiled
+        # against them (bumping the epoch + evicting, same as a
+        # recalibration), so an infer_rows/Server built after a
+        # copy_model_from can never silently dispatch a folded
+        # executable frozen with the previous model's statistics.
+        # (Folded weights themselves are live functions of the params
+        # argument; only the stats constants go stale.)
+        if self._fold_stats is not None:
+            self._fold_stats = None
+            self._fold_epoch += 1
+            self._evict_stale_infer_caches()
         ustate = {
             lk: {pn: up.init_state(params[lk][pn])
                  for pn, up in d.items() if pn in params.get(lk, {})}
@@ -500,8 +581,46 @@ class NetTrainer:
             fields[fname] = label[:, a:b]
         return fields
 
+    def _apply_tuning_cache(self) -> None:
+        """Apply tuned knob defaults from `tuning_cache =` (nnet/
+        tuning.py): only knobs the config never set explicitly, and
+        only values applicable to this trainer (an inapplicable
+        tuned value is skipped, never an error - a shared cache file
+        must not break a valid config)."""
+        if not self.tuning_cache:
+            return
+        from cxxnet_tpu.nnet import tuning
+        knobs = tuning.tuned_knobs(self.tuning_cache)
+        explicit = self._explicit_tunables
+        applied = {}
+        # tuning.int_knob is THE shared apply rule (explicit keys
+        # win, malformed values skip) - main.LearnTask consumes the
+        # same cache through the same helper
+        v = tuning.int_knob(knobs, "steps_per_dispatch", explicit, 1)
+        if v is not None:
+            self.steps_per_dispatch = applied["steps_per_dispatch"] = v
+        v = tuning.int_knob(knobs, "serve_max_batch", explicit, 0)
+        if v is not None:
+            self.serve_max_batch = applied["serve_max_batch"] = v
+        if ("stage_dtype" in knobs
+                and "stage_dtype" not in explicit):
+            val = knobs["stage_dtype"]
+            if (val in ("", "float32", "bfloat16")
+                    and not (val == "bfloat16"
+                             and self.compute_dtype
+                             == jnp.float32)):
+                self.stage_dtype = applied["stage_dtype"] = val
+        if applied:
+            telemetry.event("tuning", op="apply",
+                            cache=self.tuning_cache, **applied)
+
     def _cast(self, tree):
-        if self.compute_dtype == jnp.float32:
+        if (self.compute_dtype == jnp.float32
+                or self._graph_dtype_plan is not None):
+            # an autocast dtype plan owns the casts per layer
+            # (Network.forward); a wholesale bf16 pre-cast here would
+            # round the f32-stamped layers' inputs before they ever
+            # ran
             return tree
         return jax.tree.map(
             lambda a: a.astype(self.compute_dtype)
@@ -1003,20 +1122,57 @@ class NetTrainer:
             outs = eval_step(params, data, extras)
             return outs[node]
 
-        infer_jits: Dict[int, Any] = {}
+        infer_jits: Dict[Any, Any] = {}
+        pass_infer = bool(self._pipeline.infer_passes
+                          if self._pipeline is not None else False)
+
+        def infer_graph_step(node, net2, pfn, params, data, extras):
+            """Inference forward over the pass-transformed graph
+            (nnet/passes.py): params remapped/folded in-jit by pfn
+            (pruned weights are unused arguments jit drops), then the
+            same eval semantics as eval_step - deterministic augment,
+            train=False forward, f32 readout of the requested node."""
+            gp = self._cast(pfn(params))
+            if daug is not None:
+                data = daug(data, jax.random.PRNGKey(0), False)
+            inputs = {0: self._cast(data)}
+            for i, e in enumerate(extras):
+                inputs[1 + i] = self._cast(e)
+            with active_mesh(self.mesh):
+                values, _ = net2.forward(gp, inputs, train=False)
+            return values[node].astype(jnp.float32)
 
         def infer_fn(node: int):
-            fn = infer_jits.get(node)
+            import functools
+            if not pass_infer:
+                fn = infer_jits.get(node)
+                if fn is None:
+                    fn = jax.jit(
+                        functools.partial(infer_step, node),
+                        in_shardings=(pstore, dshd, eshd),
+                        out_shardings=shd)
+                    infer_jits[node] = fn
+                return fn
+            # pass-transformed inference: one executable per
+            # (node, fold calibration epoch) - a recalibration
+            # rebuilds; existing callables (e.g. a running Server's)
+            # keep working on their frozen stats
+            key = (node, self._fold_epoch)
+            fn = infer_jits.get(key)
             if fn is None:
-                import functools
+                net2, pfn, _gm = self._build_infer_graph(node)
                 fn = jax.jit(
-                    functools.partial(infer_step, node),
+                    functools.partial(infer_graph_step, node, net2,
+                                      pfn),
                     in_shardings=(pstore, dshd, eshd),
                     out_shardings=shd)
-                infer_jits[node] = fn
+                infer_jits[key] = fn
             return fn
 
         self._infer_fn = infer_fn
+        # exposed so a recalibration can evict the previous epoch's
+        # compiled executables (_calibrate_staged)
+        self._infer_jits = infer_jits
         self._eval_metric_step = None
         if metric_specs:
             self._eval_metric_step = jax.jit(
@@ -1452,6 +1608,14 @@ class NetTrainer:
         gdata = self._put_data(data)
         shd = self._batch_sharded
         gextras = tuple(distributed.put_global(e, shd) for e in extras)
+        if self.passes_need_calibration():
+            # fold_conv_bn freezes its statistics from the FIRST
+            # inference batch (docs/GRAPH_PASSES.md) - staged through
+            # this very pipeline, so on a single-shard mesh a
+            # single-batch predict is contraction-ULP-identical to
+            # the unfolded path (data-sharded meshes: per-shard vs
+            # global stats, warned at calibration)
+            self._calibrate_staged(gdata, gextras)
         out = self._infer_fn(node)(self.state["params"], gdata, gextras)
         valid = int(mask.sum())
         return distributed.fetch_local(out)[:valid]
@@ -1484,6 +1648,159 @@ class NetTrainer:
             node = self.net_cfg.num_nodes - 1
         return self._infer_fn(node)(self.state["params"], gdata,
                                     tuple(gextras))
+
+    # ------------------------------------------------------------------
+    # graph passes: infer-graph construction + fold calibration
+    # ------------------------------------------------------------------
+    def _build_infer_graph(self, node: int):
+        """(Network, param_fn, GraphModule) for the pass-transformed
+        inference graph of one output node (nnet/passes.py): the
+        infer-stage pipeline over a CLONE of the net config - prune
+        to the target's ancestors, then fold conv+bn sites whose
+        calibration stats exist. Cached per (node, fold epoch)."""
+        from cxxnet_tpu.nnet.passes import (
+            GraphModule, PassContext, make_param_fn)
+        key = (node, self._fold_epoch)
+        hit = self._infer_graph_cache.get(key)
+        if hit is not None:
+            return hit
+        gm = GraphModule.from_net_config(
+            self.net_cfg.clone(), self.batch_size, self.compute_dtype)
+        gm.dtype_plan = dict(self._graph_dtype_plan or {})
+        gm = self._pipeline.run_infer(
+            gm, PassContext(target_node=node,
+                            fold_stats=self._fold_stats))
+        net2 = Network(gm.cfg, self.batch_size)
+        net2.dtype_plan = gm.dtype_plan or None
+        out = (net2, make_param_fn(gm), gm)
+        self._infer_graph_cache[key] = out
+        return out
+
+    def passes_need_calibration(self) -> bool:
+        """True when fold_conv_bn is configured, the graph carries at
+        least one fold site, and no calibration stats exist yet - the
+        predict/extract paths then calibrate on their first batch;
+        serving without calibration runs the unfolded graph (the
+        Server warns - docs/GRAPH_PASSES.md)."""
+        if self._pipeline is None or self._fold_stats is not None:
+            return False
+        return bool(getattr(self, "_fold_sites", ()))
+
+    def calibrate_graph_passes(self, batch) -> bool:
+        """Capture the fold_conv_bn statistics from one calibration
+        DataBatch (staged through the exact inference pipeline, so on
+        a single-shard mesh a later inference of the SAME batch
+        reproduces the unfolded values to contraction-order ULP; on a
+        mesh whose data axis is > 1 the unfolded BN normalizes
+        per shard while calibration captures GLOBAL stats - see
+        _calibrate_staged). Returns True when stats were
+        (re)captured, False when nothing needed calibration."""
+        if not self.passes_need_calibration():
+            return False
+        data, _, _mask, extras = self._pad_batch(batch)
+        gdata = self._put_data(data)
+        shd = self._batch_sharded
+        gextras = tuple(distributed.put_global(e, shd)
+                        for e in extras)
+        return self._calibrate_staged(gdata, gextras)
+
+    def _calibrate_staged(self, gdata, gextras) -> bool:
+        """Fold calibration on already-staged device rows: ONE jitted
+        forward over the UNFOLDED graph computing each fold site's BN
+        input moments with BatchNormLayer._normalize's arithmetic
+        (f32 stats, same axes, rsqrt(var + eps)) - the frozen
+        (mean, rstd) the folded weights are built from. One-time
+        executable; steady-state inference never recompiles it.
+
+        Sharding caveat (docs/GRAPH_PASSES.md "when folding loses"):
+        the stats here are GLOBAL over the calibration batch, while
+        the unfolded BN on a mesh with data-axis size > 1 normalizes
+        each shard with its OWN stats - so the ULP-level fold parity
+        holds on single-shard meshes only; on a sharded data mesh
+        folding deliberately replaces per-shard batch statistics
+        with the frozen global ones (warned below - for serving that
+        is the batch-composition-independence feature, for accuracy
+        work it is a semantics change to opt into knowingly)."""
+        if not self.passes_need_calibration():
+            return False
+        from cxxnet_tpu.parallel.mesh import active_mesh
+        sites = self._fold_sites
+        net = self.net
+        daug = self._augment_fn
+        if self.mesh.shape.get("data", 1) > 1:
+            telemetry.stderr(
+                "graph_passes: fold_conv_bn calibrating GLOBAL batch "
+                "statistics on a data-sharded mesh; the unfolded BN "
+                "uses per-shard stats, so folded outputs are not "
+                "ULP-comparable to unfolded ones here "
+                "(docs/GRAPH_PASSES.md)\n",
+                event_kind="graph_passes", op="calibrate_sharded",
+                data_axis=self.mesh.shape.get("data", 1))
+
+        def stats_fn(params, data, extras):
+            cparams = self._cast(params)
+            if daug is not None:
+                data = daug(data, jax.random.PRNGKey(0), False)
+            inputs = {0: self._cast(data)}
+            for i, e in enumerate(extras):
+                inputs[1 + i] = self._cast(e)
+            # tap each fold site's BN INPUT as the layer receives it:
+            # a `layer[+0] = batch_norm` self-loop overwrites its
+            # node, so reading values[node] after the forward would
+            # capture POST-normalization moments (~(beta, 1/slope))
+            # and fold silently wrong weights
+            taps: Dict[int, Any] = {j: None for _i, j in sites}
+            with active_mesh(self.mesh):
+                net.forward(cparams, inputs, train=False, taps=taps)
+            out = {}
+            for _i, j in sites:
+                lay = net.layer_objs[j]
+                x = taps[j]
+                xf = x.astype(jnp.float32)
+                axes, _slices = lay._axes(x.shape)
+                mean = jnp.mean(xf, axis=axes, keepdims=True)
+                var = jnp.mean((xf - mean) ** 2, axis=axes,
+                               keepdims=True)
+                rstd = lax.rsqrt(var + lay.eps)
+                out[param_key(self.net_cfg, j)] = (mean.reshape(-1),
+                                                   rstd.reshape(-1))
+            return out
+
+        jfn = jax.jit(
+            stats_fn,
+            in_shardings=(self._params_store_shard,
+                          self._data_sharded,
+                          (self._batch_sharded,)
+                          * self.net_cfg.extra_data_num),
+            out_shardings=self._replicated)
+        res = jfn(self.state["params"], gdata, gextras)
+        self._fold_stats = {
+            k: (np.asarray(distributed.fetch_local(m)),
+                np.asarray(distributed.fetch_local(r)))
+            for k, (m, r) in res.items()}
+        self._fold_epoch += 1
+        self._evict_stale_infer_caches()
+        telemetry.event("graph_passes", op="calibrate",
+                        sites=sorted(self._fold_stats))
+        return True
+
+    def _evict_stale_infer_caches(self) -> None:
+        """Drop transformed graphs + compiled executables of every
+        fold epoch but the current one: nothing re-reads them through
+        _infer_fn (a running Server pinned its own fn reference and
+        keeps it) - without eviction a copy_model_from/predict reload
+        loop would leak one compiled executable + Network clone per
+        recalibration, and a stale-stats executable could be
+        re-dispatched after a params reload."""
+        epoch = self._fold_epoch
+        self._infer_graph_cache = {
+            k: v for k, v in self._infer_graph_cache.items()
+            if k[1] == epoch}
+        jits = getattr(self, "_infer_jits", None)
+        if jits is not None:
+            for k in [k for k in jits
+                      if isinstance(k, tuple) and k[1] != epoch]:
+                del jits[k]
 
     # graftlint: hot-path
     def evaluate(self, data_iter, data_name: str) -> str:
@@ -1741,6 +2058,16 @@ class NetTrainer:
         params[lk[0]][lk[1]] = distributed.put_global_full(
             arr, self._params_store_shard[lk[0]][lk[1]])
         self.state["params"] = params
+        # weights changed: frozen fold statistics describe the OLD
+        # activations - retire them + the executables compiled
+        # against them, same invalidation _init_state applies for
+        # copy_model_from/load_model (the next inference
+        # recalibrates; folded W' tracks live weights, but the baked
+        # mean/rstd would not)
+        if self._fold_stats is not None:
+            self._fold_stats = None
+            self._fold_epoch += 1
+            self._evict_stale_infer_caches()
 
     def check_weights(self) -> List[str]:
         """test_on_server analog (async_updater-inl.hpp:144-153): verify

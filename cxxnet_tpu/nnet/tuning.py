@@ -1,0 +1,138 @@
+"""TVM-style persistent per-platform tuning cache (arXiv:1802.04799).
+
+`tools/autotune.py` searches the dispatch/staging/serving knob space
+against measured throughput and persists the winners here; the CLI
+and wrapper pick them up via `tuning_cache = <path>` (docs/
+GRAPH_PASSES.md "Autotuner"). Contract: tuned values are DEFAULTS -
+a key the user's config sets explicitly always wins, and a cache
+entry for a different platform (or an inapplicable knob) is silently
+ignored, so shipping one cache file across a heterogeneous fleet is
+safe.
+
+File format (JSON, written atomically):
+
+    {"version": 1,
+     "platforms": {
+       "cpu": {"knobs": {"steps_per_dispatch": 4, "prefetch_stage": 1,
+                         "serve_max_batch": 32, "stage_dtype": ""},
+               "measured": {"default_ips": ..., "best_ips": ...},
+               "device_kind": "...", "date": "YYYY-MM-DD"}}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from cxxnet_tpu.utils.config import ConfigError
+
+VERSION = 1
+
+#: every knob the autotuner may set, with the config key it maps to.
+#: `stage_dtype` is the staged-input layout axis (f32 vs bf16 H2D
+#: bytes - docs/PERFORMANCE.md); `serve_max_batch` is the serving
+#: bucket-ladder ceiling (docs/SERVING.md).
+TUNABLE_KEYS = ("steps_per_dispatch", "prefetch_stage",
+                "serve_max_batch", "stage_dtype")
+
+
+def load_cache(path: str) -> dict:
+    """Parse + schema-check a tuning-cache file (raises ConfigError:
+    a cache the user POINTED AT must never be silently garbage)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            blob = json.load(f)
+    except OSError as e:
+        raise ConfigError(f"tuning_cache: cannot read {path}: {e}")
+    except ValueError as e:
+        raise ConfigError(f"tuning_cache: {path} is not JSON: {e}")
+    if (not isinstance(blob, dict)
+            or not isinstance(blob.get("platforms"), dict)):
+        raise ConfigError(
+            f"tuning_cache: {path} has no 'platforms' mapping (not a "
+            "tools/autotune.py artifact?)")
+    for plat, entry in blob["platforms"].items():
+        if entry is not None and not isinstance(entry, dict):
+            raise ConfigError(
+                f"tuning_cache: {path} platform '{plat}' entry is "
+                f"{type(entry).__name__}, expected an object")
+        knobs = (entry or {}).get("knobs", {})
+        if not isinstance(knobs, dict):
+            raise ConfigError(
+                f"tuning_cache: {path} platform '{plat}' 'knobs' is "
+                f"{type(knobs).__name__}, expected an object")
+        unknown = [k for k in knobs if k not in TUNABLE_KEYS]
+        if unknown:
+            raise ConfigError(
+                f"tuning_cache: {path} platform '{plat}' carries "
+                f"unknown knob(s) {unknown}; tunable keys are "
+                f"{list(TUNABLE_KEYS)}")
+    return blob
+
+
+def tuned_knobs(path: str,
+                platform: Optional[str] = None) -> Dict[str, str]:
+    """The cache's knob dict for `platform` (default: the live jax
+    backend), values stringified for set_param-style application.
+    {} when the cache has no entry for this platform."""
+    blob = load_cache(path)
+    if platform is None:
+        import jax
+        platform = jax.default_backend()
+    entry = blob["platforms"].get(platform)
+    if not entry:
+        return {}
+    return {k: str(v) for k, v in entry.get("knobs", {}).items()}
+
+
+def int_knob(knobs: Dict[str, str], key: str, explicit,
+             minimum: int) -> Optional[int]:
+    """THE apply rule for integer tunables, shared by every consumer
+    (main.LearnTask and NetTrainer) so they can never disagree on
+    the same cache file: the knob must be present, not explicitly
+    set by the config (`explicit` = the keys the config named),
+    parseable as int, and >= minimum - anything else returns None
+    (a malformed value in an otherwise-valid shared cache skips,
+    never errors)."""
+    if key not in knobs or key in explicit:
+        return None
+    try:
+        v = int(knobs[key])
+    except ValueError:
+        return None
+    return v if v >= minimum else None
+
+
+def save_entry(path: str, platform: str, knobs: Dict[str, object],
+               measured: Optional[Dict[str, float]] = None,
+               device_kind: str = "") -> dict:
+    """Merge one platform's tuned knobs into the cache file
+    (atomic write via tmp + replace; other platforms' entries are
+    preserved)."""
+    unknown = [k for k in knobs if k not in TUNABLE_KEYS]
+    if unknown:
+        raise ValueError(f"untunable knob(s) {unknown}")
+    if os.path.exists(path):
+        # an EXISTING cache must parse before we merge into it: a
+        # corrupt file (or one written by a newer version with knobs
+        # this build doesn't know) raises instead of being silently
+        # replaced - the atomic write below would otherwise destroy
+        # every other platform's entries
+        blob = load_cache(path)
+    else:
+        blob = {"version": VERSION, "platforms": {}}
+    blob["version"] = VERSION
+    blob["platforms"][platform] = {
+        "knobs": dict(knobs),
+        "measured": dict(measured or {}),
+        "device_kind": device_kind,
+        "date": time.strftime("%Y-%m-%d"),
+    }
+    from cxxnet_tpu.utils.fault import atomic_writer
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with atomic_writer(path, "w") as f:
+        json.dump(blob, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return blob

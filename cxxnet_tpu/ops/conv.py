@@ -47,6 +47,18 @@ def conv_out_dim(in_dim: int, ksize: int, stride: int, pad: int) -> int:
 _S2D_MAX_IN_CH = 4
 
 
+def s2d_auto(in_ch: int, stride: int, ky: int, kx: int,
+             num_group: int = 1) -> bool:
+    """The ONE definition of the space-to-depth auto heuristic:
+    ungrouped, strided, kernel covers the stride, and a tiny input
+    channel count. Evaluated in-op by conv2d (s2d=None) and at the
+    graph level by the `space_to_depth` pattern-rewrite pass
+    (nnet/passes.py), which stamps the decision onto the DAG - a
+    single predicate, so the two can never disagree."""
+    return (num_group == 1 and stride > 1
+            and min(ky, kx) >= stride and in_ch <= _S2D_MAX_IN_CH)
+
+
 def conv2d(x: jax.Array, w: jax.Array, stride: int, pad_y: int, pad_x: int,
            num_group: int = 1, precision=None, s2d=None) -> jax.Array:
     """Grouped 2-D convolution.
@@ -66,9 +78,8 @@ def conv2d(x: jax.Array, w: jax.Array, stride: int, pad_y: int, pad_x: int,
     if precision is None and x.dtype == jax.numpy.float32:
         precision = lax.Precision.HIGHEST
     if s2d is None:
-        s2d = (num_group == 1 and stride > 1
-               and min(w.shape[2], w.shape[3]) >= stride
-               and x.shape[1] <= _S2D_MAX_IN_CH)
+        s2d = s2d_auto(x.shape[1], stride, w.shape[2], w.shape[3],
+                       num_group)
     elif s2d and (num_group != 1 or stride <= 1):
         # an explicit force that cannot apply must not be silently
         # dropped - the user would benchmark the unrewritten conv

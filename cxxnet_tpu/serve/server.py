@@ -191,6 +191,21 @@ class Server:
                      else trainer.net_cfg.num_nodes - 1)
         dsize = trainer.mesh.shape.get("data", 1)
         self.buckets = bucket_sizes(self.max_batch, dsize)
+        if getattr(trainer, "passes_need_calibration",
+                   lambda: False)():
+            # fold_conv_bn without calibration stats: the infer
+            # executable built below is the UNFOLDED graph (safe,
+            # just unoptimized) and stays so for this Server's
+            # lifetime - warmup on zeros must never become the
+            # calibration batch. task=serve calibrates from the
+            # first pred batch before building the Server (main.py);
+            # programmatic users call trainer.calibrate_graph_passes
+            # (or predict once) first.
+            telemetry.stderr(
+                "serve: graph_passes fold_conv_bn has no calibration "
+                "stats; serving the unfolded graph (calibrate before "
+                "Server creation to fold)\n",
+                event_kind="serve", op="fold_uncalibrated")
         self._fn = trainer._infer_fn(self.node)
         c, y, x = trainer.net_cfg.input_shape
         self._input_dims = (c, y, x)

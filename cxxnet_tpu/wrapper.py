@@ -135,6 +135,18 @@ class Net:
         return self._net.extract_feature(_batch_from_numpy(data, None),
                                          node_name)
 
+    def calibrate_passes(self, data: np.ndarray,
+                         label: Optional[np.ndarray] = None) -> bool:
+        """Capture fold_conv_bn calibration statistics from one numpy
+        batch (graph_passes - docs/GRAPH_PASSES.md). predict/extract
+        self-calibrate on their first batch; call this before
+        serve_start so the serving executables compile FOLDED (an
+        uncalibrated Server serves the unfolded graph and warns).
+        Returns True when stats were captured."""
+        return self._net.calibrate_graph_passes(
+            _batch_from_numpy(np.asarray(data, dtype=np.float32),
+                              label))
+
     # -- serving (docs/SERVING.md) -------------------------------------
     def serve_start(self, max_batch: int = 0,
                     max_wait_ms: Optional[float] = None,

@@ -61,6 +61,23 @@ def test_recompile_counts(audit):
     assert sizes["infer_step"] == 1
 
 
+def test_pass_audit(audit):
+    """The graph-pass pipeline's artifact contract (nnet/passes.py):
+    the folded infer jaxpr has no BN moment/variance pipeline (and
+    the unfolded one provably does, so the check isn't vacuous), the
+    dead-layer-eliminated extract never traces the pruned subgraph,
+    and the fold adds zero steady-state executables."""
+    assert _by(audit, "passes/fold", "no-bn-moment-ops")["ok"]
+    assert _by(audit, "passes/fold",
+               "strictly-smaller-traced-program")["ok"]
+    assert _by(audit, "passes/dle", "pruned-subgraph-absent")["ok"]
+    assert _by(audit, "passes/fold",
+               "zero-new-steady-state-executables")["ok"]
+    sizes = audit["cache_sizes"]
+    assert sizes["pass_infer_final"] == 1
+    assert sizes["pass_infer_early"] == 1
+
+
 def test_serve_bucket_executables(audit):
     """Serving warmup compiles exactly one executable per bucket and
     100 mixed-size requests add none (the zero-steady-state-recompile
