@@ -1110,6 +1110,83 @@ def _bench_fold(ctx) -> dict:
         return {"fold_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_plan(ctx) -> dict:
+    """The PER-LAYER autotuner's value proposition, measured
+    (schema-v2 tuning_cache, docs/GRAPH_PASSES.md "per-layer
+    autotuner"): run tools/autotune.py's bounded greedy per-layer
+    search on the bf16 BN-convnet (autocast pass armed, so
+    `layer_dtype` flips feed the dtype plan - on hosts without fast
+    bf16 conv the per-layer f32 pins are a real win), persist the
+    plan as a v2 cache, and drive the SAME predict loop with the
+    plan replayed via `tuning_cache =` vs defaults in the same
+    window. `plan_over_default` is the ratio the per-layer plan buys
+    over global defaults; the plan itself lands in `plan_layers` so
+    the artifact doubles as tuning evidence. Disable with
+    CXN_BENCH_PLAN=0; CXN_BENCH_PLAN_SECS bounds the search
+    (default 20)."""
+    if os.environ.get("CXN_BENCH_PLAN") == "0":
+        return {}
+    try:
+        import shutil
+        import tempfile
+
+        import jax
+        from cxxnet_tpu.io.data import DataBatch
+        from cxxnet_tpu.nnet import tuning
+        from cxxnet_tpu.nnet.trainer import NetTrainer
+        from cxxnet_tpu.tools import autotune
+        from cxxnet_tpu.utils.config import parse_config_string
+        batch = ctx.batch
+        pairs = parse_config_string(
+            _BN_CONVNET_CONF + f"batch_size = {batch}\n"
+            "dtype = bfloat16\ngraph_passes = autocast\n")
+        budget = float(os.environ.get("CXN_BENCH_PLAN_SECS", "20"))
+        pl = autotune.per_layer_search(pairs, budget)
+        d = tempfile.mkdtemp(prefix="cxn_bench_plan_")
+        try:
+            cache = os.path.join(d, "plan.json")
+            tuning.save_entry(cache, jax.default_backend(), {},
+                              layers=pl["layers"])
+
+            def build(extra=()):
+                tr = NetTrainer()
+                for k, v in list(pairs) + list(extra):
+                    tr.set_param(k, v)
+                tr.init_model()
+                return tr
+
+            rng = np.random.RandomState(37)
+            db = DataBatch(
+                data=rng.rand(batch, 3, 48, 48).astype(np.float32),
+                label=rng.randint(0, 10, (batch, 1))
+                .astype(np.float32))
+
+            def ips_of(tr, budget_s=10.0):
+                tr.predict_dist(db)  # compile + warm
+                t0 = time.perf_counter()
+                tr.predict_dist(db)
+                per = max(time.perf_counter() - t0, 1e-6)
+                n = max(3, min(64, int(budget_s / per)))
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    tr.predict_dist(db)
+                return n * batch / (time.perf_counter() - t0)
+
+            default_ips = ips_of(build())
+            tuned_ips = ips_of(build([("tuning_cache", cache)]))
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        out = {"plan_tuned_ips": round(tuned_ips, 2),
+               "plan_default_ips": round(default_ips, 2),
+               "plan_layers": pl["layers"]}
+        if default_ips > 0:
+            out["plan_over_default"] = round(
+                tuned_ips / default_ips, 4)
+        return out
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"plan_error": f"{type(e).__name__}: {e}"}
+
+
 # the autotuner's default workload is the dispatch-bound tiny MLP
 # (tools/autotune.py): ~6k FLOP/img - the under-estimate convention
 AUTOTUNE_MLP_GFLOP_PER_IMG = 1e-5
@@ -1135,7 +1212,11 @@ def _bench_autotune(ctx) -> dict:
         budget = float(os.environ.get("CXN_BENCH_AUTOTUNE_SECS",
                                       "30"))
         pairs = parse_config_string(autotune._DEFAULT_CONF)
-        res = autotune.search(pairs, budget, serve=False)
+        # per_layer=False: the MLP workload has no per-layer
+        # candidates, and the plan family has its own field
+        # (_bench_plan's plan_over_default on the BN-convnet)
+        res = autotune.search(pairs, budget, serve=False,
+                              per_layer=False)
         m = res["measured"]
         out = {"autotune_best_ips": m["best_ips"],
                "autotune_best": {k: v for k, v
@@ -1325,6 +1406,7 @@ _MEASUREMENTS = (
     ("serve", _bench_serve, "CXN_BENCH_SERVE", 150, "h2d"),
     ("fold", _bench_fold, "CXN_BENCH_FOLD", 150, "h2d"),
     ("autotune", _bench_autotune, "CXN_BENCH_AUTOTUNE", 150, "h2d"),
+    ("plan", _bench_plan, "CXN_BENCH_PLAN", 150, "h2d"),
     ("attention",
      lambda c: _bench_attention(c.platform), "CXN_BENCH_ATTN", 100,
      "compute"),
@@ -1377,6 +1459,9 @@ _GFLOP_PER_IMG = {
     "fold_unfolded_ips": BN_CONVNET_FWD_GFLOP_PER_IMG,
     "autotune_best_ips": AUTOTUNE_MLP_GFLOP_PER_IMG,
     "autotune_default_ips": AUTOTUNE_MLP_GFLOP_PER_IMG,
+    # per-layer-plan family runs the BN-convnet forward
+    "plan_tuned_ips": BN_CONVNET_FWD_GFLOP_PER_IMG,
+    "plan_default_ips": BN_CONVNET_FWD_GFLOP_PER_IMG,
     "e2e_f32stage_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "device_augment_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
     "e2e_eval_train_ips": ALEXNET_TRAIN_GFLOP_PER_IMG,
@@ -1459,6 +1544,8 @@ def _derive(out: dict, batch: int, platform: str, ndev: int,
         out.pop("fold_over_infer", None)
     if not out.get("autotune_best_ips"):
         out.pop("tuned_over_default", None)
+    if not out.get("plan_tuned_ips"):
+        out.pop("plan_over_default", None)
     if e2e:
         out["metric"] = "alexnet_b%d_%s_train_e2e" % (batch, platform)
         out["value"], out["value_is"] = e2e, "e2e"
@@ -1529,7 +1616,12 @@ def _run_isolated(name: str, batch: int, steps: int, profile_dir: str,
         except subprocess.TimeoutExpired:
             p.kill()
             p.communicate()
-            return {f"{name}_error": f"timed out after {timeout_s}s"}
+            # the ROADMAP "reclaim the chip numbers" contract: one
+            # hung backend field records an explicit timeout marker
+            # and the round continues - a single wedged measurement
+            # can never zero the whole round into a CPU fallback
+            return {f"{name}_timeout": True,
+                    f"{name}_error": f"timed out after {timeout_s}s"}
         finally:
             _CURRENT_CHILD = None
         line = stdout.strip().splitlines()[-1] if stdout.strip() else ""
@@ -1593,6 +1685,7 @@ _LAST_GOOD_MAX_FIELDS = (
     "e2e_fused_ips", "zero2_ips", "serve_qps", "serve_rows_per_s",
     "fold_infer_ips", "fold_over_infer",
     "autotune_best_ips", "tuned_over_default",
+    "plan_tuned_ips", "plan_over_default",
     "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
     "resnet18_ips", "resnet18_devicedata_ips",
     "device_augment_ips", "chip_matmul_tflops", "attn_pallas_tflops",
@@ -1683,6 +1776,8 @@ _SYNC_SOURCE = {
     "autotune_best_ips": "autotune",
     "autotune_default_ips": "autotune",
     "tuned_over_default": "autotune",
+    "plan_tuned_ips": "plan", "plan_default_ips": "plan",
+    "plan_over_default": "plan",
     "compute_poolties_ips": "pool_ties", "googlenet_ips": "googlenet",
     "googlenet_devicedata_ips": "googlenet",
     "resnet18_ips": "resnet18", "resnet18_devicedata_ips": "resnet18",

@@ -474,6 +474,15 @@ def _traced(jitfn, args):
                 if e.primitive.name == "dot_general"))
 
 
+def _traced_prims(jitfn, args) -> Tuple[int, Dict[str, int]]:
+    """(eqn_count, {primitive: count}) of a jit's PRE-DCE trace."""
+    eqns = jitfn.trace(*args).jaxpr.jaxpr.eqns
+    prims: Dict[str, int] = {}
+    for e in eqns:
+        prims[e.primitive.name] = prims.get(e.primitive.name, 0) + 1
+    return len(eqns), prims
+
+
 def _pass_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
     """Audit the graph-pass pipeline: build the BN trainer twice
     (passes off / fold+dle on), calibrate the fold on a fixed batch,
@@ -542,7 +551,153 @@ def _pass_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
         f"cache={sizes['pass_infer_early']} after full+short "
         "predicts and extracts (want 1 each - padding keeps the "
         "program shape static, folding adds nothing per dispatch)"))
+    _new_pattern_audit(checks)
     return sizes
+
+
+# fuse_activation workload: fullc + separate bias layer + relu - the
+# chain whose standalone elementwise equations the fused node removes
+_CONF_ACT = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+0] = bias:bs1
+  init_bias = 0.05
+layer[+1:r1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,36
+batch_size = 32
+dev = cpu
+eta = 0.3
+silent = 1
+seed = 7
+"""
+
+# merge_conv_1x1 workload: 3x3 conv feeding a 1x1 conv
+_CONF_1X1 = """
+netconfig=start
+layer[+1:c1] = conv:c1
+  nchannel = 4
+  kernel_size = 3
+  pad = 1
+layer[+1:c2] = conv:c2
+  nchannel = 6
+  kernel_size = 1
+layer[+1:fl] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 8
+dev = cpu
+eta = 0.1
+silent = 1
+seed = 5
+"""
+
+# cse_share workload: a primary and its share[...] sibling reading the
+# SAME input node - provably identical, the dedupable duplicate
+_CONF_CSE = """
+netconfig=start
+layer[0->a] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[0->b] = share[fc1]
+layer[a,b->c] = concat
+layer[+1:fc2] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,12
+batch_size = 8
+dev = cpu
+eta = 0.1
+silent = 1
+seed = 3
+"""
+
+
+def _new_pattern_audit(checks: List[Dict[str, Any]]) -> None:
+    """Pass-audit legs for the PR-11 patterns (fuse_activation,
+    merge_conv_1x1, cse_share), each asserted at the traced-jaxpr
+    level against the same pipeline WITHOUT the pattern pass, and
+    each vacuity-guarded: the off-trace must actually contain the
+    pattern (the rsqrt-style guard) or the comparison proves
+    nothing."""
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    def build(conf, extra=""):
+        tr = NetTrainer()
+        for k, v in parse_config_string(conf + extra):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr
+
+    def traces(conf, passes, shape):
+        off = build(conf, "graph_passes = dead_layer_elim\n")
+        on = build(conf, f"graph_passes = dead_layer_elim,{passes}\n")
+        node = on.net_cfg.num_nodes - 1
+        data = np.zeros(shape, np.float32)
+        g, ge = on.stage_infer_rows(data)
+        g2, ge2 = off.stage_infer_rows(data)
+        e_on, p_on = _traced_prims(on._infer_fn(node),
+                                   (on.state["params"], g, ge))
+        e_off, p_off = _traced_prims(off._infer_fn(node),
+                                     (off.state["params"], g2, ge2))
+        gm_on = on._build_infer_graph(node)[2]
+        gm_off = off._build_infer_graph(node)[2]
+        return e_off, p_off, gm_off, e_on, p_on, gm_on
+
+    # fuse_activation: strictly fewer equations, equal matmul count
+    e_off, p_off, gm_off, e_on, p_on, gm_on = traces(
+        _CONF_ACT, "fuse_activation", (32, 1, 1, 36))
+    checks.append(_check(
+        "passes/fuse_activation", "pattern-matched",
+        len(gm_on.cfg.layers) < len(gm_off.cfg.layers),
+        f"fused graph keeps {len(gm_on.cfg.layers)} layers vs "
+        f"{len(gm_off.cfg.layers)} unfused - the bias+relu chain "
+        "must actually fuse (vacuity guard)"))
+    checks.append(_check(
+        "passes/fuse_activation", "fewer-eqns-equal-matmuls",
+        e_on < e_off and p_on.get("dot_general", 0)
+        == p_off.get("dot_general", 0),
+        f"fused {e_on} eqns/{p_on.get('dot_general', 0)} dots vs "
+        f"unfused {e_off}/{p_off.get('dot_general', 0)} (fusion "
+        "removes the standalone elementwise eqns, never a matmul)"))
+
+    # merge_conv_1x1: exactly one data-path conv fewer
+    _e_off, p_off, _gm_off, _e_on, p_on, gm_on = traces(
+        _CONF_1X1, "merge_conv_1x1", (8, 3, 8, 8))
+    co = p_off.get("conv_general_dilated", 0)
+    cn = p_on.get("conv_general_dilated", 0)
+    checks.append(_check(
+        "passes/merge_conv_1x1", "one-conv-fewer",
+        co >= 2 and cn == co - 1 and gm_on.merges,
+        f"merged trace carries {cn} convs vs {co} unmerged (want "
+        "exactly one fewer, with the unmerged trace carrying >= 2 - "
+        "the vacuity guard - and a recorded merge site)"))
+
+    # cse_share: the duplicate share's matmul disappears
+    e_off, p_off, gm_off, e_on, p_on, gm_on = traces(
+        _CONF_CSE, "cse_share", (8, 1, 1, 12))
+    do = p_off.get("dot_general", 0)
+    dn = p_on.get("dot_general", 0)
+    checks.append(_check(
+        "passes/cse_share", "duplicate-matmul-deduped",
+        do >= 3 and dn == do - 1 and e_on < e_off
+        and len(gm_on.cfg.layers) < len(gm_off.cfg.layers),
+        f"deduped trace carries {dn} dots/{e_on} eqns vs {do}/"
+        f"{e_off} undeduped (want one dot fewer; the undeduped "
+        "trace must carry the duplicate - vacuity guard)"))
 
 
 def _recompile_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:

@@ -84,11 +84,20 @@ class FullConnectLayer(Layer):
     def __init__(self, name: str = ""):
         super().__init__(name)
         self.fullc_gather = 0
+        self.fused_act = ""
 
     def set_param(self, name: str, val: str) -> None:
         super().set_param(name, val)
         if name == "fullc_gather":
             self.fullc_gather = int(val)
+        if name == "fused_act":
+            # activation stamped by the fuse_activation graph pass
+            # (nnet/passes.py): applied inline after the bias add so
+            # the fused node replaces the separate activation layer
+            if val not in ("", "relu"):
+                raise ValueError(
+                    f"fused_act must be '' or relu, got {val!r}")
+            self.fused_act = val
 
     def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
         self.check_one_to_one(in_shapes)
@@ -135,6 +144,8 @@ class FullConnectLayer(Layer):
             out = m @ params["wmat"].T
         if "bias" in params:
             out = out + params["bias"][None, :]
+        if self.fused_act == "relu":
+            out = ops.relu(out)
         return [out.reshape(b, 1, 1, -1)]
 
 
@@ -214,6 +225,7 @@ class ConvolutionLayer(Layer):
     def __init__(self, name: str = ""):
         super().__init__(name)
         self.s2d = None  # None = auto heuristic in ops.conv2d
+        self.fused_act = ""
 
     def set_param(self, name: str, val: str) -> None:
         if name == "space_to_depth":
@@ -221,6 +233,13 @@ class ConvolutionLayer(Layer):
                 raise ValueError(
                     f"space_to_depth must be auto, 0 or 1, got {val!r}")
             self.s2d = None if val == "auto" else val == "1"
+            return
+        if name == "fused_act":
+            # stamped by the fuse_activation graph pass (nnet/passes.py)
+            if val not in ("", "relu"):
+                raise ValueError(
+                    f"fused_act must be '' or relu, got {val!r}")
+            self.fused_act = val
             return
         super().set_param(name, val)
 
@@ -274,6 +293,8 @@ class ConvolutionLayer(Layer):
                          p.pad_x, p.num_group, s2d=self.s2d)
         if "bias" in params:
             out = out + params["bias"][None, :, None, None]
+        if self.fused_act == "relu":
+            out = ops.relu(out)
         return [out]
 
 

@@ -118,6 +118,14 @@ class LearnTask:
         # (0 = a deterministic ragged size cycle, the bucket-coverage
         # mode the serve-smoke CI job uses)
         self.serve_rows = 1
+        # explicit fold-calibration source (docs/GRAPH_PASSES.md
+        # multi-batch calibration): which iterator feeds
+        # `pass_calibration_batches` batches - "pred" (default),
+        # "train", or an eval block's name. With N = 1 and no
+        # iterator named, the lazy first-inference-batch path keeps
+        # its pinned single-batch behavior
+        self.pass_calibration_iter = ""
+        self.pass_calibration_batches = 1
         self.cfg: List[Tuple[str, str]] = []
         # index of the first command-line override pair in self.cfg
         # (None = everything is file-like); _split_blocks uses it to
@@ -294,6 +302,13 @@ class LearnTask:
             self.serve_rows = int(val)
         if name == "tuning_cache":
             self.tuning_cache = val
+        if name == "pass_calibration_iter":
+            self.pass_calibration_iter = val
+        if name == "pass_calibration_batches":
+            if int(val) < 1:
+                raise ValueError(
+                    "pass_calibration_batches must be >= 1")
+            self.pass_calibration_batches = int(val)
         self.cfg.append((name, val))
 
     def _apply_tuning_cache(self) -> None:
@@ -846,9 +861,74 @@ class LearnTask:
                 telemetry.emit_metrics(kind="round", round=round_label,
                                        **stats)
 
+    def _calibration_source(self):
+        """(iterator, name) behind `pass_calibration_iter` - "pred"
+        (default), "train", or an eval block's name."""
+        name = self.pass_calibration_iter
+        if name in ("", "pred"):
+            return self.itr_pred, "pred"
+        if name == "train":
+            return self.itr_train, "train"
+        for it, nm in zip(self.itr_evals, self.eval_names):
+            if nm == name:
+                return it, nm
+        raise ValueError(
+            f"pass_calibration_iter={name!r}: no such iterator "
+            f"(have: train, pred"
+            + ("".join(", " + n for n in self.eval_names)) + ")")
+
+    def _calibrate_passes(self) -> bool:
+        """Explicit fold calibration (docs/GRAPH_PASSES.md): pull
+        `pass_calibration_batches` batches from the named calibration
+        iterator and average the frozen moments over them. A no-op -
+        returning False so callers keep the pinned lazy
+        first-inference-batch path - when nothing needs calibration,
+        or when neither multi-batch nor an explicit iterator was
+        requested."""
+        tr = self.net_trainer
+        if not tr.passes_need_calibration():
+            return False
+        n = self.pass_calibration_batches
+        if n <= 1 and not self.pass_calibration_iter:
+            return False
+        import numpy as np
+        from cxxnet_tpu.io.data import DataBatch
+        it, src = self._calibration_source()
+        assert it is not None, \
+            f"pass_calibration_iter={src!r}: iterator not configured"
+        batches = []
+        it.before_first()
+        while len(batches) < n and it.next():
+            b = it.value()
+            # iterators may reuse their batch buffers across next():
+            # snapshot the arrays for the multi-batch moment pool
+            batches.append(DataBatch(
+                data=(None if b.data is None else np.array(b.data)),
+                label=np.array(b.label),
+                inst_index=(None if b.inst_index is None
+                            else np.array(b.inst_index)),
+                num_batch_padd=b.num_batch_padd,
+                extra_data=[np.array(e) for e in b.extra_data],
+                sparse_row_ptr=(None if b.sparse_row_ptr is None
+                                else np.array(b.sparse_row_ptr)),
+                sparse_findex=(None if b.sparse_findex is None
+                               else np.array(b.sparse_findex)),
+                sparse_fvalue=(None if b.sparse_fvalue is None
+                               else np.array(b.sparse_fvalue))))
+        it.before_first()
+        if not batches:
+            return False
+        self.net_trainer.calibrate_graph_passes(
+            batches if len(batches) > 1 else batches[0])
+        telemetry.stdout(
+            f"graph_passes: calibrated on {len(batches)} batch(es) "
+            f"from the {src} iterator")
+        return True
+
     def task_predict(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a predict iterator to generate predictions"
+        self._calibrate_passes()
         telemetry.stdout("start predicting...")
         # tmp + os.replace: a crash mid-run cannot leave a truncated
         # prediction file behind (same protocol as checkpoint saves)
@@ -871,6 +951,7 @@ class LearnTask:
         that conf intended."""
         assert self.itr_pred is not None, \
             "must specify a predict iterator to generate predictions"
+        self._calibrate_passes()
         telemetry.stdout("start predicting...")
         with atomic_writer(self.name_pred, "w") as fo:
             self.itr_pred.before_first()
@@ -909,11 +990,14 @@ class LearnTask:
             "must specify a predict iterator to drive task = serve"
         import numpy as np
         from cxxnet_tpu.serve import Server, predictions_from_rows
-        if self.net_trainer.passes_need_calibration():
+        if (not self._calibrate_passes()
+                and self.net_trainer.passes_need_calibration()):
             # fold_conv_bn needs statistics BEFORE the bucket
             # executables compile (they are frozen per Server): use
             # the first pred batch - the same source the predict
-            # path calibrates from (docs/GRAPH_PASSES.md)
+            # path calibrates from (docs/GRAPH_PASSES.md); the
+            # explicit multi-batch/named-iterator path above takes
+            # precedence when configured
             self.itr_pred.before_first()
             if self.itr_pred.next():
                 self.net_trainer.calibrate_graph_passes(
@@ -992,6 +1076,7 @@ class LearnTask:
             "must specify a predict iterator to generate predictions"
         assert self.extract_node_name, \
             "extract node name must be specified in task extract"
+        self._calibrate_passes()
         telemetry.stdout("start predicting...")
         nrow = 0
         dshape = None

@@ -50,13 +50,43 @@ engine (docs/GRAPH_PASSES.md). Shipped passes:
   calibration batch IS the inference batch and approximate
   otherwise.
 
+- **cse_share** (infer stage): common-subexpression sharing - two
+  sibling layers reading the SAME input nodes and computing the same
+  function (both fed by one primary's params via share[...], or both
+  param-less with identical configs) produce identical values at
+  eval, so the duplicate is deduped: its consumers re-read the kept
+  layer's output node and shares of a dropped primary re-point to the
+  kept duplicate's param source (the dead-primary promotion idea of
+  dead_layer_elim applied sideways). Layers with their OWN params are
+  never deduped against each other - equal weights cannot be proven
+  from the graph.
+- **merge_conv_1x1** (infer stage): two adjacent convs where the
+  second is 1x1/stride-1/pad-0/ungrouped collapse into ONE conv via
+  weight contraction `W' = W2 . W1` (`b' = W2 . b1 + b2`), computed
+  in-jit from the LIVE params like fold_conv_bn's make_param_fn
+  treatment - the traced infer program carries exactly one fewer
+  conv (the weight-side contraction is a tiny dot, not a data-sized
+  conv). Sites where the intermediate activation is the requested
+  output, either conv is weight-shared/grouped, or an activation
+  sits between the convs are excluded.
+- **fuse_activation** (infer stage): a conv/fullc followed by a
+  chain of separate `bias` layers and/or one `relu` gets the
+  activation STAMPED into the producer (`fused_act = relu`, consumed
+  by the layer's apply) and the bias layers' params absorbed into
+  the producer's bias (`b' = b + sum(b_i)`, live in-jit) - the infer
+  jaxpr loses the separate per-layer elementwise equations (a
+  standalone bias layer costs a broadcast + a data-sized add; the
+  absorbed form is one vector add inside the param function).
+
 Passes never touch the training graph structure or the checkpoint
 format: graph-stage passes only stamp layer configs / dtype
 annotations (NetConfig.to_dict is structure-only), and infer-stage
 passes run on a clone consumed solely by the inference executables.
 
 On top, the TVM-style tuning cache (arXiv:1802.04799) lives in
-`nnet/tuning.py` and `tools/autotune.py`.
+`nnet/tuning.py` and `tools/autotune.py` - since cache schema v2 it
+carries per-layer plans (s2d per conv, per-layer dtype) and a
+telemetry-shaped serve bucket ladder next to the global knobs.
 """
 
 from __future__ import annotations
@@ -78,6 +108,13 @@ _F32_SENSITIVE_TYPES = frozenset((
 # fold pattern: the producing layer types a batch_norm folds into
 _FOLDABLE_TYPES = frozenset(("conv", "fullc"))
 
+# fuse_activation pattern: producers that accept a `fused_act` stamp,
+# and the elementwise layer types that fuse into them (bias layers
+# absorb into the producer's bias; ONE activation ends the chain)
+_ACT_PRODUCER_TYPES = frozenset(("conv", "fullc"))
+_ACT_CHAIN_TYPES = frozenset(("bias", "relu"))
+_ACT_TYPES = frozenset(("relu",))
+
 
 # ---------------------------------------------------------------------------
 # the IR the passes transform
@@ -95,6 +132,27 @@ class FoldSite:
 
 
 @dataclass
+class MergeSite:
+    """One conv + 1x1-conv pair collapsed into the first conv: the
+    live-params keys of both convs. make_param_fn contracts
+    `W' = W2 . W1` / `b' = W2 . b1 + b2` from the LIVE weights."""
+
+    first_key: str
+    second_key: str
+
+
+@dataclass
+class ActFuseSite:
+    """One producer whose trailing bias layers were absorbed: the
+    producer's live-params key plus the absorbed bias layers' keys
+    (in chain order). The activation itself is a config stamp
+    (`fused_act`), not a param transform."""
+
+    producer_key: str
+    bias_keys: List[str]
+
+
+@dataclass
 class GraphModule:
     """A NetConfig DAG in flight through the pass pipeline.
 
@@ -109,6 +167,8 @@ class GraphModule:
     compute_dtype: Any = None
     param_keys: List[Optional[str]] = field(default_factory=list)
     folds: List[FoldSite] = field(default_factory=list)
+    merges: List[MergeSite] = field(default_factory=list)
+    act_fuses: List[ActFuseSite] = field(default_factory=list)
     dtype_plan: Dict[int, Any] = field(default_factory=dict)
     log: List[str] = field(default_factory=list)
 
@@ -239,6 +299,153 @@ def find_fold_sites(cfg: NetConfig) -> List[Tuple[int, int]]:
     return sites
 
 
+def node_writers(cfg: NetConfig, node: int) -> List[int]:
+    """Layer indices writing a node (declaration order)."""
+    return [k for k, li in enumerate(cfg.layers)
+            if node in li.nindex_out]
+
+
+def layer_obj(cfg: NetConfig, idx: int):
+    """Instantiate layer `idx` with its effective (defcfg + layercfg)
+    config - the pattern matchers' way to read structured layer
+    params (kernel size, stride, groups) without building a Network.
+    Shared layers resolve to their primary's object. None when the
+    config is rejected (an invalid layer cannot match a pattern)."""
+    from cxxnet_tpu.layers import create_layer
+    info = cfg.layers[idx]
+    src = info.primary_layer_index if info.is_shared else idx
+    try:
+        lay = create_layer(cfg.layers[src].type_name,
+                           cfg.layers[src].name)
+        for k, v in cfg.defcfg + cfg.layercfg[src]:
+            lay.set_param(k, v)
+    except (KeyError, ValueError):
+        return None
+    return lay
+
+
+def next_fusable_link(cfg: NetConfig, cons, primaries, node: int,
+                      last_writer: int,
+                      target: Optional[int]) -> Optional[int]:
+    """The single fusable elementwise consumer of `node` downstream
+    of `last_writer`, or None. Mirrors find_fold_sites' reader rules:
+    a self-loop layer may have later readers (they see the post-layer
+    value the fused producer reproduces) but none between the writer
+    and itself; a new-node layer must be the node's sole reader."""
+    if node == target:
+        return None  # the caller asked for this intermediate value
+    readers = sorted(cons.get(node, ()))
+    after = [c for c in readers if c > last_writer]
+    if not after:
+        return None
+    j = after[0]
+    info = cfg.layers[j]
+    if (info.is_shared or j in primaries
+            or info.type_name not in _ACT_CHAIN_TYPES
+            or len(info.nindex_in) != 1 or len(info.nindex_out) != 1
+            or info.nindex_in[0] != node):
+        return None
+    if any(last_writer < w < j for w in node_writers(cfg, node)):
+        return None  # a foreign writer clobbers the chain value
+    if info.nindex_out[0] == node:
+        if any(last_writer < c < j for c in readers if c != j):
+            return None
+        return j
+    if len(after) > 1:
+        return None  # a second reader needs the raw value
+    return j
+
+
+def find_act_chains(cfg: NetConfig, target: Optional[int],
+                    dtype_plan: Optional[Dict[int, Any]] = None,
+                    ) -> List[Tuple[int, List[int]]]:
+    """(producer_idx, [chain layer indices]) for every conv/fullc
+    whose output feeds a fusable bias*/relu chain. Bias layers absorb
+    until ONE activation ends the chain; weight-shared layers are
+    excluded on both sides, and a chain stops at the first layer
+    whose per-layer dtype stamp differs from the producer's (a fused
+    layer runs at the producer's dtype - a `layer_dtype` pin on the
+    bias/relu must survive)."""
+    primaries = share_primaries(cfg)
+    cons = node_consumers(cfg)
+    out: List[Tuple[int, List[int]]] = []
+    claimed: set = set()
+    for i, prod in enumerate(cfg.layers):
+        if (prod.type_name not in _ACT_PRODUCER_TYPES or prod.is_shared
+                or i in primaries or len(prod.nindex_out) != 1):
+            continue
+        if any(k == "fused_act"
+               for k, _ in cfg.defcfg + cfg.layercfg[i]):
+            continue  # already carries a stamp: nothing to add
+        node, last = prod.nindex_out[0], i
+        chain: List[int] = []
+        while True:
+            j = next_fusable_link(cfg, cons, primaries, node, last,
+                                  target)
+            if (j is None or j in claimed
+                    or (dtype_plan or {}).get(j)
+                    != (dtype_plan or {}).get(i)):
+                break
+            chain.append(j)
+            node, last = cfg.layers[j].nindex_out[0], j
+            if cfg.layers[j].type_name in _ACT_TYPES:
+                break  # bias past the activation must stay separate
+        if chain:
+            out.append((i, chain))
+            claimed.update(chain)
+    return out
+
+
+def find_merge_site(cfg: NetConfig, target: Optional[int],
+                    dtype_plan: Optional[Dict[int, Any]] = None,
+                    ) -> Optional[Tuple[int, int]]:
+    """First (conv_idx, onexone_idx) pair matching the 1x1-merge
+    pattern, or None: an ungrouped conv whose single output node
+    feeds EXACTLY one ungrouped 1x1/stride-1/pad-0 conv, neither
+    weight-shared, no activation stamped on either, and the
+    intermediate node not the requested output. Convs with DIFFERENT
+    per-layer dtype stamps never merge - the merged conv runs at the
+    first conv's dtype, which would silently override the other
+    layer's `layer_dtype` pin (explicit-keys-always-win)."""
+    primaries = share_primaries(cfg)
+    cons = node_consumers(cfg)
+    for j, second in enumerate(cfg.layers):
+        if (second.type_name != "conv" or second.is_shared
+                or j in primaries or len(second.nindex_in) != 1
+                or len(second.nindex_out) != 1
+                or second.nindex_out[0] == second.nindex_in[0]):
+            continue
+        a = second.nindex_in[0]
+        if a == target:
+            continue
+        obj2 = layer_obj(cfg, j)
+        if (obj2 is None or obj2.param.kernel_height != 1
+                or obj2.param.kernel_width != 1
+                or obj2.param.stride != 1
+                or obj2.param.pad_y or obj2.param.pad_x
+                or obj2.param.num_group != 1
+                or getattr(obj2, "fused_act", "")):
+            continue
+        writers = node_writers(cfg, a)
+        if len(writers) != 1 or writers[0] >= j:
+            continue
+        i = writers[0]
+        first = cfg.layers[i]
+        if (first.type_name != "conv" or first.is_shared
+                or i in primaries or len(first.nindex_out) != 1):
+            continue
+        if (dtype_plan or {}).get(i) != (dtype_plan or {}).get(j):
+            continue  # differing dtype stamps: a pin must survive
+        if [c for c in cons.get(a, ()) if c != j]:
+            continue  # another reader needs the intermediate value
+        obj1 = layer_obj(cfg, i)
+        if (obj1 is None or obj1.param.num_group != 1
+                or getattr(obj1, "fused_act", "")):
+            continue
+        return i, j
+    return None
+
+
 # ---------------------------------------------------------------------------
 # passes
 # ---------------------------------------------------------------------------
@@ -259,9 +466,12 @@ class GraphPass:
 PASS_REGISTRY: Dict[str, Type[GraphPass]] = {}
 
 # canonical application order (infer passes prune first so the fold
-# never sees - or folds - a dead subgraph)
+# never sees - or folds - a dead subgraph; cse next so dedupe exposes
+# single-consumer fold/merge sites; fuse_activation LAST so chains
+# uncovered by the fold and the 1x1 merge still fuse)
 _CANONICAL_ORDER = ("space_to_depth", "autocast",
-                    "dead_layer_elim", "fold_conv_bn")
+                    "dead_layer_elim", "cse_share", "fold_conv_bn",
+                    "merge_conv_1x1", "fuse_activation")
 
 
 def register_pass(cls: Type[GraphPass]) -> Type[GraphPass]:
@@ -461,6 +671,164 @@ class FoldConvBNPass(GraphPass):
         return gm
 
 
+@register_pass
+class CseSharePass(GraphPass):
+    """Common-subexpression sharing (module docstring): dedupe
+    sibling layers that provably compute the same value - same input
+    nodes AND same function (same live-params source for weighted
+    layers, or identical type+config for param-less ones). Runs to a
+    fixpoint so a dedupe that makes two downstream siblings identical
+    cascades."""
+
+    name = "cse_share"
+    stage = "infer"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        while self._sweep(gm, ctx):
+            pass
+        return gm
+
+    @staticmethod
+    def _signature(gm: GraphModule, idx: int):
+        from cxxnet_tpu.layers.loss import LossLayer
+        cfg = gm.cfg
+        info = cfg.layers[idx]
+        if (len(info.nindex_out) != 1
+                or info.nindex_out[0] in info.nindex_in):
+            return None  # multi-output or self-loop: not a candidate
+        if node_writers(cfg, info.nindex_out[0]) != [idx]:
+            return None  # aliased output node
+        obj = layer_obj(cfg, idx)
+        if obj is None or isinstance(obj, LossLayer):
+            return None
+        src = info.primary_layer_index if info.is_shared else idx
+        # layers stamped with different compute dtypes produce
+        # different values - never "the same function"
+        plan_d = gm.dtype_plan.get(idx)
+        if obj.param_tags():
+            # weighted layer: identical only when the params COME from
+            # the same place (a primary and its share[...], or two
+            # shares of one primary) - equal weights of two distinct
+            # primaries cannot be proven from the graph
+            return ("params", src, tuple(info.nindex_in), plan_d)
+        return ("pure", cfg.layers[src].type_name,
+                tuple(cfg.layercfg[src]), tuple(info.nindex_in),
+                plan_d)
+
+    def _sweep(self, gm: GraphModule, ctx: PassContext) -> bool:
+        cfg = gm.cfg
+        groups: Dict[Any, List[int]] = {}
+        for idx in range(len(cfg.layers)):
+            sig = self._signature(gm, idx)
+            if sig is not None:
+                groups.setdefault(sig, []).append(idx)
+        drops: List[int] = []
+        remap: Dict[int, int] = {}
+        for members in groups.values():
+            if len(members) < 2:
+                continue
+            kept = members[0]
+            kept_info = cfg.layers[kept]
+            kept_src = (kept_info.primary_layer_index
+                        if kept_info.is_shared else kept)
+            for j in members[1:]:
+                dj = cfg.layers[j].nindex_out[0]
+                if dj == ctx.target_node:
+                    continue  # the duplicate's node IS the output
+                # shares of a dropped primary re-point to the kept
+                # duplicate's param source (same params by the
+                # signature) - the dead-primary promotion machinery's
+                # rule applied sideways
+                for s_li in cfg.layers:
+                    if (s_li.is_shared
+                            and s_li.primary_layer_index == j):
+                        s_li.primary_layer_index = kept_src
+                remap[dj] = kept_info.nindex_out[0]
+                drops.append(j)
+                gm.log.append(
+                    f"cse_share: layer[{j}] duplicates layer[{kept}]"
+                    f" ({cfg.layers[kept_src].type_name}); consumers "
+                    f"re-read node {kept_info.nindex_out[0]}")
+        if not drops:
+            return False
+        for li in cfg.layers:
+            li.nindex_in = [remap.get(n, n) for n in li.nindex_in]
+        gm.remove_layers(drops)
+        return True
+
+
+@register_pass
+class MergeConv1x1Pass(GraphPass):
+    """Collapse conv + 1x1-conv chains into one conv via live weight
+    contraction (module docstring). Runs to a fixpoint so a
+    conv->1x1->1x1 tower folds flat."""
+
+    name = "merge_conv_1x1"
+    stage = "infer"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        while True:
+            site = find_merge_site(gm.cfg, ctx.target_node,
+                                   gm.dtype_plan)
+            if site is None:
+                return gm
+            i, j = site
+            cfg = gm.cfg
+            first_key, second_key = gm.param_keys[i], gm.param_keys[j]
+            obj2 = layer_obj(cfg, j)
+            # the merged conv keeps the first conv's geometry (kernel,
+            # stride, pad, s2d stamp) and takes the second's output
+            # width; its weights/bias arrive contracted via the param
+            # function, so no init-time config beyond nchannel changes
+            cfg.layercfg[i].append(
+                ("nchannel", str(obj2.param.num_channel)))
+            cfg.layers[i].nindex_out = list(cfg.layers[j].nindex_out)
+            gm.merges.append(MergeSite(first_key=first_key,
+                                       second_key=second_key))
+            gm.remove_layers([j])
+            gm.log.append(
+                f"merge_conv_1x1: contracted {second_key} (1x1) into "
+                f"{first_key}")
+
+
+@register_pass
+class FuseActivationPass(GraphPass):
+    """Stamp trailing relu chains into their conv/fullc producer and
+    absorb separate bias layers into the producer's bias (module
+    docstring). Runs LAST in canonical order so chains exposed by
+    fold_conv_bn / merge_conv_1x1 fuse too."""
+
+    name = "fuse_activation"
+    stage = "infer"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        cfg = gm.cfg
+        chains = find_act_chains(cfg, ctx.target_node, gm.dtype_plan)
+        if not chains:
+            return gm
+        drops: List[int] = []
+        for i, chain in chains:
+            bias_keys = [gm.param_keys[j] for j in chain
+                         if cfg.layers[j].type_name == "bias"]
+            act = next((cfg.layers[j].type_name for j in chain
+                        if cfg.layers[j].type_name in _ACT_TYPES), "")
+            cfg.layers[i].nindex_out = list(
+                cfg.layers[chain[-1]].nindex_out)
+            if act:
+                cfg.layercfg[i].append(("fused_act", act))
+            if bias_keys:
+                gm.act_fuses.append(ActFuseSite(
+                    producer_key=gm.param_keys[i],
+                    bias_keys=bias_keys))
+            drops.extend(chain)
+            gm.log.append(
+                f"fuse_activation: {gm.param_keys[i]} absorbs "
+                f"{len(bias_keys)} bias layer(s)"
+                + (f" + {act}" if act else ""))
+        gm.remove_layers(drops)
+        return gm
+
+
 # ---------------------------------------------------------------------------
 # params of a transformed graph, from the live train params
 # ---------------------------------------------------------------------------
@@ -471,30 +839,75 @@ def make_param_fn(gm: GraphModule):
     the LIVE weights (the folded weights track checkpoint loads and
     set_weight), with only mean/rstd frozen at calibration - and
     rstd precomputed, so no rsqrt (let alone a moment reduction)
-    appears in the folded jaxpr."""
+    appears in the folded jaxpr. Merge sites contract
+    `W' = W2 . W1` / `b' = W2 . b1 + b2` and act-fuse sites absorb
+    separate bias-layer params (`b' = b + sum(b_i)`) - applied in
+    stages AFTER the folds so a folded conv that later merged (or
+    grew a fused activation) composes: each stage reads the previous
+    stage's transform of the same live key."""
     import jax.numpy as jnp
     pairs = list(gm.param_map().items())
-    fold_by_key = {s.conv_key: s for s in gm.folds}
 
     def param_fn(params):
-        out = {}
-        for new_key, live_key in pairs:
-            if live_key not in params:
+        cur: Dict[str, Any] = {}
+
+        def live(key):
+            return cur.get(key, params.get(key))
+
+        for site in gm.folds:
+            if site.conv_key not in params:
                 continue
-            site = fold_by_key.get(live_key)
-            if site is None:
-                out[new_key] = params[live_key]
-                continue
-            conv_p, bn_p = params[live_key], params[site.bn_key]
+            conv_p, bn_p = params[site.conv_key], params[site.bn_key]
             k = bn_p["slope"] * jnp.asarray(site.rstd)
             w = conv_p["wmat"]
             kw = k.reshape((-1,) + (1,) * (w.ndim - 1))
             bias = conv_p.get("bias", jnp.zeros_like(k))
-            out[new_key] = {
+            cur[site.conv_key] = {
                 "wmat": w * kw.astype(w.dtype),
                 "bias": (bias - jnp.asarray(site.mean)) * k
                         + bn_p["bias"],
             }
+        for site in gm.merges:
+            # BOTH convs read through live(): either side may carry
+            # an earlier fold's transform (conv->1x1->bn folds into
+            # the 1x1 BEFORE the merge contracts it), and a missing
+            # key skips the transform like the fold guard above
+            p1, p2 = live(site.first_key), live(site.second_key)
+            if p1 is None or p2 is None:
+                continue
+            w1, w2 = p1["wmat"], p2["wmat"]
+            # (O2, O1, 1, 1) -> (O2, O1); contract over the first
+            # conv's output channels - a weight-sized dot, never a
+            # data-sized conv
+            k2 = w2.reshape(w2.shape[0], w2.shape[1])
+            entry = {"wmat": jnp.einsum("oi,i...->o...",
+                                        k2.astype(w1.dtype), w1)}
+            b1, b2 = p1.get("bias"), p2.get("bias")
+            if b1 is not None:
+                b = k2 @ b1
+                entry["bias"] = b + b2 if b2 is not None else b
+            elif b2 is not None:
+                entry["bias"] = b2
+            cur[site.first_key] = entry
+        for site in gm.act_fuses:
+            src = live(site.producer_key)
+            if src is None or any(bk not in params
+                                  for bk in site.bias_keys):
+                continue
+            p = dict(src)
+            b = p.get("bias")
+            for bk in site.bias_keys:
+                extra = params[bk]["bias"]
+                b = extra if b is None else b + extra
+            if b is not None:
+                p["bias"] = b
+            cur[site.producer_key] = p
+
+        out = {}
+        for new_key, live_key in pairs:
+            v = live(live_key)
+            if v is not None:
+                out[new_key] = v
         return out
 
     return param_fn

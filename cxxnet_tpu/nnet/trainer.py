@@ -170,6 +170,11 @@ class NetTrainer:
         self.serve_max_batch = 0
         self.serve_max_wait_ms = 2.0
         self.serve_replicas = 1
+        # explicit serving bucket ladder (serve_bucket_ladder = comma
+        # ints; None = power-of-two default): Server(trainer) reads
+        # it; a tuning-cache serve_ladder fills it as a default under
+        # the explicit-keys-win rule (docs/GRAPH_PASSES.md)
+        self.serve_ladder: Optional[List[int]] = None
         # graph-level optimizing passes over the NetConfig DAG
         # (nnet/passes.py, docs/GRAPH_PASSES.md): comma list of pass
         # names ("" = off, "all" = every registered pass) plus
@@ -182,6 +187,11 @@ class NetTrainer:
         self._pass_toggles: Dict[str, int] = {}
         self._pipeline = None
         self._graph_dtype_plan = None
+        # fold_conv_bn calibration batches: 1 = the historic
+        # single-batch freeze (bitwise-pinned); N > 1 averages moments
+        # over N calibration batches (calibrate_graph_passes with a
+        # batch sequence - main.py's pass_calibration_iter feeds it)
+        self.pass_calibration_batches = 1
         # fold_conv_bn calibration state: bn param key -> (mean,
         # rstd) frozen at calibration; epoch keys the per-node infer
         # executable cache so a recalibration rebuilds cleanly
@@ -294,18 +304,36 @@ class NetTrainer:
             if int(val) < 1:
                 raise ValueError("serve_replicas must be >= 1")
             self.serve_replicas = int(val)
+        if name == "serve_bucket_ladder":
+            rungs = [int(t) for t in val.split(",") if t.strip()]
+            if (not rungs or any(r < 1 for r in rungs)
+                    or sorted(set(rungs)) != rungs):
+                raise ValueError(
+                    "serve_bucket_ladder must be a strictly "
+                    f"increasing comma list of positive ints, got "
+                    f"{val!r}")
+            self.serve_ladder = rungs
         if name == "graph_passes":
             self.graph_passes = val
-        if name.startswith("pass_"):
+        if name == "pass_calibration_batches":
+            if int(val) < 1:
+                raise ValueError(
+                    "pass_calibration_batches must be >= 1")
+            self.pass_calibration_batches = int(val)
+        if (name.startswith("pass_")
+                and name not in ("pass_calibration_batches",
+                                 "pass_calibration_iter")):
             # per-pass toggles layered over graph_passes (membership
             # add/remove): prefix-form so a new @register_pass needs
             # no handler edit here; the name is validated against the
-            # pass registry at _build_net with did-you-mean
+            # pass registry at _build_net with did-you-mean.
+            # pass_calibration_* are calibration knobs, not toggles
+            # (pass_calibration_iter is consumed by main.LearnTask)
             self._pass_toggles[name[len("pass_"):]] = int(val)
         if name == "tuning_cache":
             self.tuning_cache = val
         if name in ("steps_per_dispatch", "serve_max_batch",
-                    "stage_dtype"):
+                    "stage_dtype", "serve_bucket_ladder"):
             # explicit config keys beat tuning-cache defaults
             self._explicit_tunables.add(name)
         if name == "profile":
@@ -586,11 +614,17 @@ class NetTrainer:
         tuning.py): only knobs the config never set explicitly, and
         only values applicable to this trainer (an inapplicable
         tuned value is skipped, never an error - a shared cache file
-        must not break a valid config)."""
+        must not break a valid config). Schema-v2 caches additionally
+        carry a PER-LAYER plan (s2d per conv, layer_dtype feeding the
+        autocast pass) stamped onto the layer configs here - a key
+        the config already names for that layer (or globally in
+        defcfg) always wins - and a serve bucket ladder picked up
+        unless `serve_bucket_ladder =` was set."""
         if not self.tuning_cache:
             return
         from cxxnet_tpu.nnet import tuning
-        knobs = tuning.tuned_knobs(self.tuning_cache)
+        entry = tuning.platform_entry(self.tuning_cache)
+        knobs = {k: str(v) for k, v in entry.get("knobs", {}).items()}
         explicit = self._explicit_tunables
         applied = {}
         # tuning.int_knob is THE shared apply rule (explicit keys
@@ -610,9 +644,53 @@ class NetTrainer:
                              and self.compute_dtype
                              == jnp.float32)):
                 self.stage_dtype = applied["stage_dtype"] = val
+        plan_applied = self._apply_layer_plan(entry.get("layers") or {})
+        if plan_applied:
+            applied["layers"] = plan_applied
+        ladder = entry.get("serve_ladder")
+        if (ladder and self.serve_ladder is None
+                and "serve_bucket_ladder" not in explicit):
+            try:
+                rungs = sorted({int(b) for b in ladder if int(b) >= 1})
+            except (TypeError, ValueError):
+                rungs = []
+            if rungs:
+                self.serve_ladder = rungs
+                applied["serve_ladder"] = rungs
         if applied:
             telemetry.event("tuning", op="apply",
                             cache=self.tuning_cache, **applied)
+
+    def _apply_layer_plan(self, plan: Dict[str, Any]) -> Dict[str, Any]:
+        """Stamp a v2 cache's per-layer plan onto the layer configs
+        (the per-layer analog of the scalar knob pickup): skip
+        unknown layers, inapplicable knobs (s2d on a non-conv),
+        malformed values, and any key the config names for that
+        layer or globally - explicit keys always win. Stamps go into
+        net_cfg.layercfg, which NetConfig.configure rebuilds from
+        the user's pairs on every (re)configure, so they never
+        accumulate or masquerade as explicit keys."""
+        applied: Dict[str, Any] = {}
+        valid = {"space_to_depth": ("0", "1", "auto"),
+                 "layer_dtype": ("float32", "bfloat16")}
+        for lname, kv in plan.items():
+            idx = self.net_cfg.layer_name_map.get(lname)
+            if idx is None or not isinstance(kv, dict):
+                continue
+            info = self.net_cfg.layers[idx]
+            for k, v in kv.items():
+                v = str(v)
+                if k not in valid or v not in valid[k]:
+                    continue
+                if k == "space_to_depth" and info.type_name != "conv":
+                    continue
+                if any(kk == k for kk, _ in
+                       (self.net_cfg.defcfg
+                        + self.net_cfg.layercfg[idx])):
+                    continue  # explicitly configured: the user wins
+                self.net_cfg.layercfg[idx].append((k, v))
+                applied.setdefault(lname, {})[k] = v
+        return applied
 
     def _cast(self, tree):
         if (self.compute_dtype == jnp.float32
@@ -1693,8 +1771,18 @@ class NetTrainer:
         reproduces the unfolded values to contraction-order ULP; on a
         mesh whose data axis is > 1 the unfolded BN normalizes
         per shard while calibration captures GLOBAL stats - see
-        _calibrate_staged). Returns True when stats were
+        _calibrate_staged). A SEQUENCE of batches instead averages
+        the frozen moments over all of them (multi-batch
+        calibration, `pass_calibration_batches` - less sensitive to
+        one unlucky batch; the single-batch path stays
+        bitwise-unchanged). Returns True when stats were
         (re)captured, False when nothing needed calibration."""
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 1:
+                # one-element sequence rides the pinned single-batch
+                # arithmetic (bitwise default)
+                return self.calibrate_graph_passes(batch[0])
+            return self._calibrate_batches(list(batch))
         if not self.passes_need_calibration():
             return False
         data, _, _mask, extras = self._pad_batch(batch)
@@ -1703,6 +1791,118 @@ class NetTrainer:
         gextras = tuple(distributed.put_global(e, shd)
                         for e in extras)
         return self._calibrate_staged(gdata, gextras)
+
+    def _calibrate_batches(self, batches: List) -> bool:
+        """Multi-batch fold calibration: ONE jitted moments forward
+        (mean, var per fold site - the same tap + f32 arithmetic as
+        _calibrate_staged) run per calibration batch, the per-batch
+        moments pooled on the host (valid-row-weighted mean of means;
+        var from the pooled second moment), rstd = 1/sqrt(var + eps)
+        precomputed so the folded jaxpr still carries no rsqrt.
+        Padding rows (a round_batch=0 iterator zero-fills its tail
+        batch) are masked out of both the per-batch moments and the
+        pooling weights."""
+        if not batches:
+            raise ValueError("calibration needs at least one batch")
+        if not self.passes_need_calibration():
+            return False
+        from cxxnet_tpu.parallel.mesh import active_mesh
+        if self.mesh.shape.get("data", 1) > 1:
+            # same documented caveat as _calibrate_staged: global
+            # frozen stats vs the unfolded BN's per-shard stats
+            telemetry.stderr(
+                "graph_passes: fold_conv_bn calibrating GLOBAL batch "
+                "statistics on a data-sharded mesh; the unfolded BN "
+                "uses per-shard stats, so folded outputs are not "
+                "ULP-comparable to unfolded ones here "
+                "(docs/GRAPH_PASSES.md)\n",
+                event_kind="graph_passes", op="calibrate_sharded",
+                data_axis=self.mesh.shape.get("data", 1))
+        sites = self._fold_sites
+        net = self.net
+        daug = self._augment_fn
+        eps_by_key = {param_key(self.net_cfg, j):
+                      net.layer_objs[j].eps for _i, j in sites}
+
+        def moments_fn(params, data, extras, mask):
+            cparams = self._cast(params)
+            if daug is not None:
+                data = daug(data, jax.random.PRNGKey(0), False)
+            inputs = {0: self._cast(data)}
+            for i, e in enumerate(extras):
+                inputs[1 + i] = self._cast(e)
+            taps: Dict[int, Any] = {j: None for _i, j in sites}
+            with active_mesh(self.mesh):
+                net.forward(cparams, inputs, train=False, taps=taps)
+            out = {}
+            for _i, j in sites:
+                lay = net.layer_objs[j]
+                xf = taps[j].astype(jnp.float32)
+                axes, _slices = lay._axes(taps[j].shape)
+                # moments over REAL rows only: a round_batch=0
+                # iterator zero-pads its tail batch, and all-zero
+                # rows would drag the pooled frozen stats toward 0
+                # (the pinned single-batch path keeps them - there
+                # the calibration batch IS the inference batch)
+                m = jnp.broadcast_to(
+                    mask.astype(jnp.float32).reshape(
+                        (-1,) + (1,) * (xf.ndim - 1)), xf.shape)
+                denom = jnp.sum(m, axis=axes, keepdims=True)
+                mean = jnp.sum(xf * m, axis=axes,
+                               keepdims=True) / denom
+                var = jnp.sum(m * (xf - mean) ** 2, axis=axes,
+                              keepdims=True) / denom
+                out[param_key(self.net_cfg, j)] = (mean.reshape(-1),
+                                                   var.reshape(-1))
+            return out
+
+        jfn = jax.jit(
+            moments_fn,
+            in_shardings=(self._params_store_shard,
+                          self._data_sharded,
+                          (self._batch_sharded,)
+                          * self.net_cfg.extra_data_num,
+                          self._batch_sharded),
+            out_shardings=self._replicated)
+        per_batch: List[Dict[str, Any]] = []
+        weights: List[float] = []
+        for b in batches:
+            data, _, mask, extras = self._pad_batch(b)
+            gdata = self._put_data(data)
+            shd = self._batch_sharded
+            gextras = tuple(distributed.put_global(e, shd)
+                            for e in extras)
+            gmask = distributed.put_global(
+                np.asarray(mask, np.float32), shd)
+            res = jfn(self.state["params"], gdata, gextras, gmask)
+            per_batch.append({
+                k: (np.asarray(distributed.fetch_local(m)),
+                    np.asarray(distributed.fetch_local(v)))
+                for k, (m, v) in res.items()})
+            weights.append(float(np.asarray(mask).sum()))
+        w = np.asarray(weights, np.float64)
+        w = w / w.sum()
+        stats: Dict[str, Any] = {}
+        for key in per_batch[0]:
+            means = np.stack([pb[key][0] for pb in per_batch])
+            variances = np.stack([pb[key][1] for pb in per_batch])
+            # pooled moments over the union of REAL rows: each batch
+            # weighted by its valid-row count, var from the pooled
+            # second moment E[x^2] - E[x]^2 with E[x^2]_i = var_i
+            # + mean_i^2
+            mean = (means * w[:, None]).sum(axis=0)
+            var = ((variances + means ** 2)
+                   * w[:, None]).sum(axis=0) - mean ** 2
+            rstd = 1.0 / np.sqrt(np.maximum(var, 0.0)
+                                 + eps_by_key[key])
+            stats[key] = (mean.astype(np.float32),
+                          rstd.astype(np.float32))
+        self._fold_stats = stats
+        self._fold_epoch += 1
+        self._evict_stale_infer_caches()
+        telemetry.event("graph_passes", op="calibrate",
+                        sites=sorted(stats), batches=len(batches))
+        return True
 
     def _calibrate_staged(self, gdata, gextras) -> bool:
         """Fold calibration on already-staged device rows: ONE jitted

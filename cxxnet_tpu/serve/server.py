@@ -74,6 +74,58 @@ def bucket_sizes(max_batch: int, data_axis: int = 1) -> Tuple[int, ...]:
     return tuple(sorted(out))
 
 
+def ladder_buckets(ladder: Sequence[int], max_batch: int,
+                   data_axis: int = 1) -> Tuple[int, ...]:
+    """An EXPLICIT bucket ladder (the autotuner's telemetry-shaped
+    rungs, or `serve_bucket_ladder =` - docs/GRAPH_PASSES.md) folded
+    into a valid bucket set: rungs outside [1, max_batch] or not
+    divisible by the mesh's data axis are dropped (the
+    inapplicable-tuned-value rule - a cache shaped on one mesh must
+    not break another), and `max_batch` itself always closes the
+    ladder. The max_batch/data-axis contract is bucket_sizes'."""
+    if max_batch < 1:
+        raise ValueError("serve_max_batch must be >= 1")
+    if max_batch % max(data_axis, 1):
+        raise ValueError(
+            f"serve_max_batch={max_batch} must be a multiple of the "
+            f"mesh's data-axis size ({data_axis}) - every bucket "
+            "dispatches over that axis")
+    axis = max(data_axis, 1)
+    out = {int(b) for b in ladder
+           if 1 <= int(b) <= max_batch and int(b) % axis == 0}
+    out.add(max_batch)
+    return tuple(sorted(out))
+
+
+def ladder_from_histogram(hist, max_batch: int, data_axis: int = 1,
+                          rungs: int = 4) -> Tuple[int, ...]:
+    """Shape a bucket ladder from an observed request-size histogram
+    ({size: count}, the Server's `request_sizes` stat): one rung at
+    each 1/rungs quantile of the size distribution, rounded UP to the
+    data axis, closed by `max_batch`. Sizes the traffic actually
+    sends get tight buckets (less padding); sizes it never sends get
+    no bucket (fewer warmed executables) - the TVM move of shaping
+    the search space from the workload instead of a fixed
+    power-of-two set. Falls back to bucket_sizes on an empty
+    histogram."""
+    sizes = sorted((int(s), int(c)) for s, c in dict(hist).items()
+                   if int(c) > 0 and int(s) >= 1)
+    if not sizes:
+        return bucket_sizes(max_batch, data_axis)
+    axis = max(data_axis, 1)
+    total = sum(c for _, c in sizes)
+    ladder = []
+    for r in range(1, max(rungs, 1) + 1):
+        target = r * total / max(rungs, 1)
+        acc = 0
+        for s, c in sizes:
+            acc += c
+            if acc >= target:
+                ladder.append(-(-s // axis) * axis)  # ceil to axis
+                break
+    return ladder_buckets(ladder, max_batch, data_axis)
+
+
 def predictions_from_rows(rows: np.ndarray) -> np.ndarray:
     """The TransformPred rule (trainer.predict) applied to raw final-
     node rows: single-column output passes through as scalars, wider
@@ -167,7 +219,8 @@ class Server:
                  replicas: Optional[int] = None,
                  node: int = -1,
                  metrics_port: Optional[int] = None,
-                 metrics_host: str = "0.0.0.0") -> None:
+                 metrics_host: str = "0.0.0.0",
+                 ladder: Optional[Sequence[int]] = None) -> None:
         import jax
         if trainer.state is None:
             raise RuntimeError(
@@ -190,7 +243,14 @@ class Server:
         self.node = (node if node >= 0
                      else trainer.net_cfg.num_nodes - 1)
         dsize = trainer.mesh.shape.get("data", 1)
-        self.buckets = bucket_sizes(self.max_batch, dsize)
+        # explicit ladder > trainer's (tuned or serve_bucket_ladder =)
+        # ladder > the power-of-two default - the same
+        # explicit-keys-win chain the scalar serve knobs ride
+        lad = (ladder if ladder is not None
+               else getattr(trainer, "serve_ladder", None))
+        self.buckets = (ladder_buckets(lad, self.max_batch, dsize)
+                        if lad else
+                        bucket_sizes(self.max_batch, dsize))
         if getattr(trainer, "passes_need_calibration",
                    lambda: False)():
             # fold_conv_bn without calibration stats: the infer
@@ -249,6 +309,12 @@ class Server:
         self._n_errors = 0
         # guarded-by: self._lock
         self._bucket_hits: Dict[int, int] = {b: 0 for b in self.buckets}
+        # request-size histogram: the serve telemetry the autotuner's
+        # ladder_from_histogram shapes the bucket ladder from
+        # (docs/GRAPH_PASSES.md "per-layer autotuner"); counts per
+        # submitted work-item row count
+        # guarded-by: self._lock
+        self._size_hist: Dict[int, int] = {}
         self._lat = telemetry.Histogram()
 
     # -- lifecycle ---------------------------------------------------------
@@ -383,6 +449,8 @@ class Server:
         with self._lock:
             self._n_requests += 1
             self._n_rows += data.shape[0]
+            for it in items:
+                self._size_hist[it.n] = self._size_hist.get(it.n, 0) + 1
         telemetry.inc("serve.requests")
         telemetry.inc("serve.rows", data.shape[0])
         telemetry.set_gauge("serve.queue_depth", depth)
@@ -490,6 +558,7 @@ class Server:
                 "padding_rows": self._n_padding,
                 "errors": self._n_errors,
                 "buckets": {b: n for b, n in self._bucket_hits.items()},
+                "request_sizes": dict(self._size_hist),
             }
         out["warmup_s"] = round(self.warmup_s, 4)
         for q, key in ((50, "latency_p50_ms"), (99, "latency_p99_ms")):

@@ -4,6 +4,7 @@ docs/GRAPH_PASSES.md "Autotuner").
 
     python -m cxxnet_tpu.tools.autotune [--out models/tuning_cache.json]
         [--conf workload.conf] [--budget-secs N] [--serve 0|1]
+        [--per-layer 0|1]
 
 Searched knobs (nnet/tuning.py TUNABLE_KEYS):
 
@@ -14,10 +15,22 @@ Searched knobs (nnet/tuning.py TUNABLE_KEYS):
   a fused chunk, a shallow one starves it);
 - `serve_max_batch`: the serving bucket-ladder ceiling, measured as
   rows/sec through a real warmed `serve.Server` under a mixed-size
-  request storm;
+  request storm - and, from the storm's own request-size histogram
+  (the Server's `request_sizes` telemetry), a SHAPED bucket ladder
+  (`serve.ladder_from_histogram`) replacing the fixed power-of-two
+  set, persisted as the v2 cache's `serve_ladder` when it measures
+  at least as fast;
 - `stage_dtype` (the staged-input layout axis): bf16 vs f32 H2D
   staging, measured only when the workload computes in bf16 (the
   knob is a no-op under f32 - docs/PERFORMANCE.md).
+
+Per-layer search (`--per-layer 1`, schema-v2 `layers` plans -
+nnet/tuning.py LAYER_TUNABLE_KEYS): a bounded greedy flip of
+`space_to_depth` per strided conv and `layer_dtype` per conv/fullc
+(bf16 + autocast workloads, feeding the autocast pass's dtype plan),
+each candidate measured through the REAL cache-pickup path (a temp
+tuning_cache the trainer replays), so a plan that wins the search is
+by construction a plan the product applies.
 
 The winners persist under `--out` keyed by jax backend platform
 (cpu/gpu/tpu); `main.py` / `wrapper.Net` pick them up via
@@ -155,14 +168,19 @@ def measure_train_ips(tr, batches: List, k: int, prefetch: int,
     return n * tr.batch_size / dt
 
 
-def measure_serve_rows(tr, max_batch: int, budget_s: float) -> float:
-    """rows/sec through a warmed continuous-batching Server at one
-    bucket-ladder ceiling, under a mixed-size request storm."""
+def measure_serve_rows(tr, max_batch: int, budget_s: float,
+                       ladder=None):
+    """(rows/sec, stats) through a warmed continuous-batching Server
+    at one bucket-ladder ceiling, under a mixed-size request storm.
+    `ladder` passes an explicit bucket ladder (the shaped-ladder
+    measurement); the stats carry the storm's request-size histogram
+    (`request_sizes`) the ladder shaping reads."""
     from cxxnet_tpu.serve import Server
     c, y, x = tr.net_cfg.input_shape
     rng = np.random.RandomState(29)
     data = rng.rand(max_batch, c, y, x).astype(np.float32)
-    srv = Server(tr, max_batch=max_batch, max_wait_ms=2.0, replicas=2)
+    srv = Server(tr, max_batch=max_batch, max_wait_ms=2.0, replicas=2,
+                 ladder=ladder)
     srv.warmup()
     srv.start()
     try:
@@ -188,20 +206,99 @@ def measure_serve_rows(tr, max_batch: int, budget_s: float) -> float:
         stats = srv.stop()
     if stats["errors"]:
         raise RuntimeError(f"{stats['errors']} serve dispatch errors")
-    return total / dt
+    return total / dt, stats
+
+
+def _measure_plan_ips(conf_pairs, extra, plan, batches,
+                      budget_s: float) -> float:
+    """e2e images/sec of a per-layer plan candidate, measured through
+    the REAL pickup path: the plan is written to a temp tuning_cache
+    and a fresh trainer replays it via `tuning_cache =` - so the
+    search can never win with a plan the product would not apply."""
+    import tempfile
+
+    import jax
+    from cxxnet_tpu.nnet import tuning
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="cxn_tune_")
+    os.close(fd)
+    os.unlink(path)
+    try:
+        tuning.save_entry(path, jax.default_backend(), {},
+                          layers=plan)
+        tr = _make_trainer(conf_pairs,
+                           list(extra) + [("tuning_cache", path)])
+        return measure_train_ips(tr, batches, 1, 0, budget_s)
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+def per_layer_search(conf_pairs: Sequence[Tuple[str, str]],
+                     budget_s: float,
+                     extra: Sequence[Tuple[str, str]] = (),
+                     max_layers: int = 6) -> Dict:
+    """Bounded greedy per-layer knob search (docs/GRAPH_PASSES.md
+    "per-layer autotuner"): for each named strided conv flip
+    `space_to_depth` 0/1, and - on bf16 workloads running the
+    autocast pass - flip conv/fullc layers' `layer_dtype` to f32.
+    A flip joins the plan only when it beats the incumbent by > 2%
+    (hysteresis: measurement noise must not churn plans). Returns
+    {"layers": plan, "grid": per-candidate ips}."""
+    import jax.numpy as jnp
+    base = _make_trainer(conf_pairs, extra)
+    cands: List[Tuple[str, str, Tuple[str, ...]]] = []
+    autocast_on = (base.compute_dtype == jnp.bfloat16
+                   and base._pipeline is not None
+                   and base._pipeline.has("autocast"))
+    for idx, info in enumerate(base.net_cfg.layers):
+        if info.is_shared or not info.name:
+            continue
+        explicit = {k for k, _ in (base.net_cfg.defcfg
+                                   + base.net_cfg.layercfg[idx])}
+        lay = base.net.layer_objs[idx]
+        if (info.type_name == "conv" and lay.param.stride > 1
+                and "space_to_depth" not in explicit):
+            cands.append((info.name, "space_to_depth", ("0", "1")))
+        if (autocast_on and info.type_name in ("conv", "fullc")
+                and "layer_dtype" not in explicit):
+            cands.append((info.name, "layer_dtype", ("float32",)))
+    cands = cands[:max_layers]
+    grid: Dict[str, float] = {}
+    if not cands:
+        return {"layers": {}, "grid": grid}
+    batches = _synth_batches(base, 8)
+    n_meas = 1 + sum(len(c[2]) for c in cands)
+    per = max(1.0, budget_s / n_meas)
+    plan: Dict[str, Dict[str, str]] = {}
+    best = _measure_plan_ips(conf_pairs, extra, {}, batches, per)
+    grid["plan_default"] = round(best, 2)
+    for lname, key, alts in cands:
+        for v in alts:
+            trial = {ln: dict(kv) for ln, kv in plan.items()}
+            trial.setdefault(lname, {})[key] = v
+            ips = _measure_plan_ips(conf_pairs, extra, trial,
+                                    batches, per)
+            grid[f"{lname}.{key}={v}"] = round(ips, 2)
+            if ips > best * 1.02:
+                best = ips
+                plan = trial
+    return {"layers": plan, "grid": grid,
+            "plan_best_ips": round(best, 2)}
 
 
 def search(conf_pairs: Sequence[Tuple[str, str]], budget_s: float,
-           serve: bool = True,
+           serve: bool = True, per_layer: bool = True,
            extra: Sequence[Tuple[str, str]] = ()) -> Dict:
-    """Run the bounded knob search; returns {knobs, measured}. The
-    `default_ips` cell (K=1, prefetch_stage=1 - the shipped
-    defaults) is always measured first so `tuned_over_default` is an
-    in-window ratio, never a cross-run comparison."""
+    """Run the bounded knob search; returns {knobs, measured, layers,
+    serve_ladder}. The `default_ips` cell (K=1, prefetch_stage=1 -
+    the shipped defaults) is always measured first so
+    `tuned_over_default` is an in-window ratio, never a cross-run
+    comparison."""
     tr = _make_trainer(conf_pairs, extra)
     batches = _synth_batches(tr, 8)
     cells = [(k, p) for k in _K_GRID for p in _PREFETCH_GRID]
-    per_cell = max(1.0, budget_s * 0.7 / len(cells))
+    knob_share = 0.7 - (0.2 if per_layer else 0.0)
+    per_cell = max(1.0, budget_s * knob_share / len(cells))
     measured: Dict[str, float] = {}
     grid: Dict[str, float] = {}
     best = (None, -1.0)
@@ -216,17 +313,42 @@ def search(conf_pairs: Sequence[Tuple[str, str]], budget_s: float,
     measured["best_ips"] = round(best_ips, 2)
     knobs: Dict[str, object] = {"steps_per_dispatch": bk,
                                 "prefetch_stage": bp}
+    layers: Dict[str, Dict[str, str]] = {}
+    serve_ladder = None
+    if per_layer:
+        pl = per_layer_search(conf_pairs, budget_s * 0.2, extra)
+        layers = pl["layers"]
+        grid.update(pl["grid"])
+        if "plan_best_ips" in pl:
+            measured["plan_best_ips"] = pl["plan_best_ips"]
     if serve:
+        from cxxnet_tpu.serve import ladder_from_histogram
         sbest = (None, -1.0)
-        ladder = [m for m in _SERVE_GRID]
-        per_mb = max(1.0, budget_s * 0.3 / len(ladder))
-        for mb in ladder:
-            rows = measure_serve_rows(tr, mb, per_mb)
+        hist: Dict[int, int] = {}
+        per_mb = max(1.0, budget_s * 0.25 / (len(_SERVE_GRID) + 1))
+        for mb in _SERVE_GRID:
+            rows, stats = measure_serve_rows(tr, mb, per_mb)
             grid[f"serve_mb{mb}"] = round(rows, 2)
+            for s, c in stats.get("request_sizes", {}).items():
+                hist[int(s)] = hist.get(int(s), 0) + int(c)
             if rows > sbest[1]:
                 sbest = (mb, rows)
         knobs["serve_max_batch"] = sbest[0]
         measured["serve_rows_per_s"] = round(sbest[1], 2)
+        # ladder shaped from the storm's own request-size telemetry
+        # (docs/SERVING.md "bucket ladder"): adopted only when it does
+        # not lose to the power-of-two set at the winning ceiling;
+        # rungs ceil to the workload mesh's data axis so the measured
+        # ladder IS the persisted one (an unceiled rung would be
+        # silently dropped by ladder_buckets at serve time)
+        shaped = ladder_from_histogram(
+            hist, sbest[0], tr.mesh.shape.get("data", 1))
+        rows2, _st = measure_serve_rows(tr, sbest[0], per_mb,
+                                        ladder=shaped)
+        grid["serve_shaped_ladder"] = round(rows2, 2)
+        if rows2 >= 0.98 * sbest[1]:
+            serve_ladder = list(shaped)
+            measured["serve_ladder_rows_per_s"] = round(rows2, 2)
     import jax.numpy as jnp
     if tr.compute_dtype == jnp.bfloat16:
         # the staged-input layout axis: bf16 host cast vs f32 bytes
@@ -244,7 +366,8 @@ def search(conf_pairs: Sequence[Tuple[str, str]], budget_s: float,
             k or "bfloat16": round(v, 2)
             for k, v in ips_by_layout.items()}
     measured["grid"] = grid
-    return {"knobs": knobs, "measured": measured}
+    return {"knobs": knobs, "measured": measured, "layers": layers,
+            "serve_ladder": serve_ladder}
 
 
 def main() -> int:
@@ -256,6 +379,9 @@ def main() -> int:
                     help="workload config (default: builtin tiny MLP)")
     ap.add_argument("--budget-secs", type=float, default=60.0)
     ap.add_argument("--serve", type=int, default=1)
+    ap.add_argument("--per-layer", type=int, default=1,
+                    help="greedy per-layer s2d/dtype plan search "
+                    "(schema-v2 'layers' cache entries)")
     args = ap.parse_args()
     from cxxnet_tpu.utils.config import (parse_config_file,
                                          parse_config_string)
@@ -267,13 +393,16 @@ def main() -> int:
     t0 = time.perf_counter()
     try:
         result = search(pairs, args.budget_secs,
-                        serve=bool(args.serve))
+                        serve=bool(args.serve),
+                        per_layer=bool(args.per_layer))
     except Exception as e:  # noqa: BLE001 - CLI surface: say what broke
         print(f"autotune: search failed: {type(e).__name__}: {e}")
         return 1
     from cxxnet_tpu.nnet import tuning
     tuning.save_entry(args.out, platform, result["knobs"],
-                      result["measured"], device_kind=kind)
+                      result["measured"], device_kind=kind,
+                      layers=result.get("layers") or {},
+                      serve_ladder=result.get("serve_ladder"))
     dt = time.perf_counter() - t0
     m = result["measured"]
     speedup = (m["best_ips"] / m["default_ips"]
@@ -281,6 +410,10 @@ def main() -> int:
     print(f"autotune[{platform}]: best {result['knobs']} "
           f"({m['best_ips']} img/s, {speedup:.2f}x over default) "
           f"in {dt:.1f}s -> {args.out}")
+    if result.get("layers"):
+        print(f"  per-layer plan: {result['layers']}")
+    if result.get("serve_ladder"):
+        print(f"  serve ladder: {result['serve_ladder']}")
     print("  use it with: tuning_cache = " + args.out)
     return 0
 

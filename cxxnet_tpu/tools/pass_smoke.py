@@ -27,6 +27,23 @@ product surface:
   program + trace/lowering latency; the smoke asserts the traced
   sizes and reports the lowered bytes.
 
+PR-11 legs (docs/GRAPH_PASSES.md "Pass catalog"):
+
+- **activation-fusion parity**: a second trained MLP whose head is
+  fullc -> bias -> relu, `task = pred` with
+  `graph_passes = dead_layer_elim,fuse_activation` vs passes off -
+  identical argmax on every row + tight-allclose raw logits (the
+  bias absorption is a pure add-reassociation);
+- **1x1-merge parity**: an in-process child (same pinned runtime)
+  trains a conv -> 1x1-conv -> relu net and compares fused
+  (`merge_conv_1x1,fuse_activation`) vs unfolded predict_dist rows,
+  plus the one-conv-fewer traced-program claim;
+- **per-layer-plan autotune**: tools/autotune.py on a tiny budget
+  writes a schema-v2 cache (the plan JSON stays in --out as a CI
+  artifact), then the SAME pred task replays it twice via
+  `tuning_cache =` - identical output files (plans are
+  deterministic pickups, not per-run noise).
+
 Both inference legs run under `--xla_cpu_use_thunk_runtime=false`
 (the fused/zero/serve smokes' scoped pin): folded and unfolded are
 different program shapes, and the thunk runtime's per-shape codegen
@@ -84,17 +101,67 @@ silent = 1
 
 _PASSES = "graph_passes=fold_conv_bn,dead_layer_elim"
 
+# activation-fusion leg: same data blocks, fullc -> bias -> relu head
+CONF_ACT = CONF.replace(
+    "layer[+1:bn1] = batch_norm:bn1\nlayer[+1:sg1] = tanh",
+    "layer[+0] = bias:bs1\n  init_bias = 0.05\nlayer[+1:sg1] = relu")
 
-def _run_cli(out_dir: str, *overrides: str) -> subprocess.CompletedProcess:
-    env = dict(
+_ACT_PASSES = "graph_passes=dead_layer_elim,fuse_activation"
+
+# 1x1-merge leg (in-process child): conv -> 1x1 conv -> relu head
+_MERGE_CONF = """
+netconfig=start
+layer[+1:c1] = conv:c1
+  nchannel = 4
+  kernel_size = 3
+  pad = 1
+layer[+1:c2] = conv:c2
+  nchannel = 6
+  kernel_size = 1
+layer[+1:r1] = relu
+layer[+1:fl] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 16
+dev = cpu
+eta = 0.1
+silent = 1
+seed = 5
+"""
+
+
+def _pinned_env() -> dict:
+    return dict(
         os.environ, JAX_PLATFORMS="cpu",
         # append, don't replace: inherited flags must keep applying
         XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
                    + " --xla_cpu_use_thunk_runtime=false").strip())
+
+
+def _run_cli(out_dir: str, *overrides: str,
+             conf: str = "pass_smoke.conf"
+             ) -> subprocess.CompletedProcess:
     return subprocess.run(
         [sys.executable, "-m", "cxxnet_tpu.main",
-         os.path.join(out_dir, "pass_smoke.conf"), *overrides],
-        env=env, capture_output=True, text=True, timeout=540)
+         os.path.join(out_dir, conf), *overrides],
+        env=_pinned_env(), capture_output=True, text=True, timeout=540)
+
+
+def _run_merge_leg() -> dict:
+    """Spawn the --merge-leg child under the pinned runtime and parse
+    its JSON verdict."""
+    r = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu.tools.pass_smoke",
+         "--merge-leg"],
+        env=_pinned_env(), capture_output=True, text=True, timeout=540)
+    for line in r.stdout.splitlines():
+        if line.startswith("MERGELEG="):
+            return json.loads(line[len("MERGELEG="):])
+    return {"error": f"rc={r.returncode}: {r.stderr[-300:]}"}
 
 
 def _lines(path):
@@ -159,6 +226,55 @@ def _program_sizes() -> dict:
     }
 
 
+def merge_leg() -> dict:
+    """--merge-leg child (runs under the parent's pinned runtime):
+    train the conv -> 1x1-conv net a few steps, compare predict_dist
+    fused (merge_conv_1x1 + fuse_activation) vs passes off, and
+    count the traced data-path convs."""
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    def build(extra=""):
+        tr = NetTrainer()
+        for k, v in parse_config_string(_MERGE_CONF + extra):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr
+
+    def batch(i):
+        r = np.random.RandomState(300 + i)
+        return DataBatch(
+            data=r.rand(16, 3, 8, 8).astype(np.float32),
+            label=r.randint(0, 3, (16, 1)).astype(np.float32))
+
+    off = build()
+    on = build("graph_passes = dead_layer_elim,merge_conv_1x1,"
+               "fuse_activation\n")
+    for i in range(3):
+        off.update(batch(i))
+        on.update(batch(i))
+    b = batch(90)
+    po, pn = off.predict_dist(b), on.predict_dist(b)
+
+    def convs(tr):
+        node = tr.net_cfg.num_nodes - 1
+        g, ge = tr.stage_infer_rows(np.zeros((16, 3, 8, 8),
+                                             np.float32))
+        eqns = tr._infer_fn(node).trace(
+            tr.state["params"], g, ge).jaxpr.jaxpr.eqns
+        return sum(1 for e in eqns
+                   if e.primitive.name == "conv_general_dilated")
+
+    return {
+        "max_diff": float(np.abs(po - pn).max()),
+        "allclose": bool(np.allclose(po, pn, rtol=5e-4, atol=1e-6)),
+        "argmax_equal": bool((po.argmax(1) == pn.argmax(1)).all()),
+        "convs_off": convs(off),
+        "convs_on": convs(on),
+    }
+
+
 def run_smoke(out_dir: str) -> int:
     from cxxnet_tpu.telemetry.sink import read_jsonl
     write_synth_mnist(out_dir, 192, 0, "train")
@@ -214,6 +330,66 @@ def run_smoke(out_dir: str) -> int:
     ex_off, ex_on = sizes["extract_off"], sizes["extract_on"]
     fin_off, fin_on = sizes["final_off"], sizes["final_on"]
 
+    # --- activation-fusion parity leg (CLI, second trained MLP) ----
+    with open(os.path.join(out_dir, "pass_smoke_act.conf"), "w") as f:
+        f.write(CONF_ACT.format(d=out_dir))
+    mdir_a = os.path.join(out_dir, "models_act")
+    model_a = os.path.join(mdir_a, "0002.model")
+    a_off, a_on = (os.path.join(out_dir, n)
+                   for n in ("act_off.txt", "act_on.txt"))
+    ar_off, ar_on = (os.path.join(out_dir, n)
+                     for n in ("act_raw_off.txt", "act_raw_on.txt"))
+    train_a = _run_cli(out_dir, f"model_dir={mdir_a}",
+                       conf="pass_smoke_act.conf")
+    common_a = (f"model_in={model_a}", "batch_size=96")
+    act_legs = {
+        "a_off": _run_cli(out_dir, "task=pred", *common_a,
+                          f"pred={a_off}",
+                          conf="pass_smoke_act.conf"),
+        "a_on": _run_cli(out_dir, "task=pred", *common_a,
+                         f"pred={a_on}", _ACT_PASSES,
+                         conf="pass_smoke_act.conf"),
+        "ar_off": _run_cli(out_dir, "task=pred_raw", *common_a,
+                           f"pred={ar_off}",
+                           conf="pass_smoke_act.conf"),
+        "ar_on": _run_cli(out_dir, "task=pred_raw", *common_a,
+                          f"pred={ar_on}", _ACT_PASSES,
+                          conf="pass_smoke_act.conf"),
+    }
+    ao, an = _lines(a_off), _lines(a_on)
+    aro, arn = _lines(ar_off), _lines(ar_on)
+    act_diff, act_close = float("nan"), False
+    if aro and arn and len(aro) == len(arn):
+        fa, fb = _floats(aro), _floats(arn)
+        act_diff = float(np.abs(fa - fb).max())
+        act_close = bool(np.allclose(fa, fb, rtol=5e-4, atol=1e-6))
+
+    # --- 1x1-merge parity leg (pinned in-process child) ------------
+    merge = _run_merge_leg()
+
+    # --- per-layer-plan autotune leg: tiny grid, cache written then
+    # replayed - the plan JSON stays in out_dir as the CI artifact
+    plan_json = os.path.join(out_dir, "tuning_plan.json")
+    at = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu.tools.autotune",
+         "--out", plan_json, "--budget-secs", "5", "--serve", "1",
+         "--per-layer", "1"],
+        env=_pinned_env(), capture_output=True, text=True,
+        timeout=540)
+    plan_blob = {}
+    if os.path.exists(plan_json):
+        with open(plan_json) as f:
+            plan_blob = json.load(f)
+    t1, t2 = (os.path.join(out_dir, n)
+              for n in ("tuned_pred_1.txt", "tuned_pred_2.txt"))
+    tuned_legs = [
+        _run_cli(out_dir, "task=pred", *common, f"pred={t1}",
+                 f"tuning_cache={plan_json}"),
+        _run_cli(out_dir, "task=pred", *common, f"pred={t2}",
+                 f"tuning_cache={plan_json}"),
+    ]
+    to1, to2 = _lines(t1), _lines(t2)
+
     checks = [
         ("train run completed",
          train.returncode == 0 and os.path.exists(model)),
@@ -242,16 +418,42 @@ def run_smoke(out_dir: str) -> int:
          f"({ex_on['lowered_bytes']} vs {ex_off['lowered_bytes']} B;"
          " equal = jax's own DCE, the documented finding)",
          ex_on["lowered_bytes"] <= ex_off["lowered_bytes"]),
+        ("act-fusion legs completed",
+         train_a.returncode == 0
+         and all(r.returncode == 0 for r in act_legs.values())),
+        ("act-fusion parity: identical argmax predictions (96 lines)",
+         ao is not None and ao == an and len(ao) == 96),
+        ("act-fusion parity: tight-allclose pred_raw logits "
+         f"(max diff {act_diff:.2e})", act_close),
+        ("1x1-merge parity: allclose rows + identical argmax "
+         f"(max diff {merge.get('max_diff', float('nan')):.2e})",
+         merge.get("allclose", False)
+         and merge.get("argmax_equal", False)),
+        ("1x1-merge: exactly one conv fewer in the traced program "
+         f"({merge.get('convs_on')} vs {merge.get('convs_off')})",
+         merge.get("convs_off", 0) >= 2
+         and merge.get("convs_on") == merge.get("convs_off", 0) - 1),
+        ("autotune leg: schema-v2 cache with a per-layer plan field",
+         at.returncode == 0 and plan_blob.get("version") == 2
+         and "layers" in plan_blob.get("platforms", {}).get("cpu", {})),
+        ("autotune leg: cache replay is deterministic "
+         "(two identical tuned pred files, 96 lines)",
+         all(r.returncode == 0 for r in tuned_legs)
+         and to1 is not None and to1 == to2 and len(to1) == 96),
     ]
     ok = True
     for label, passed in checks:
         print(f"  [{'ok' if passed else 'FAIL'}] {label}")
         ok = ok and bool(passed)
     if not ok:
-        for tag, r in [("train", train)] + list(legs.items()):
+        for tag, r in ([("train", train), ("train_act", train_a),
+                        ("autotune", at)]
+                       + list(legs.items()) + list(act_legs.items())):
             if r.returncode != 0:
                 print(f"--- {tag} stderr tail ---")
                 print(r.stderr[-2000:])
+        if "error" in merge:
+            print(f"--- merge leg ---\n{merge['error']}")
     with open(os.path.join(out_dir, "pass_sizes.json"), "w") as f:
         json.dump(sizes, f, indent=1, sort_keys=True)
     print(f"pass_smoke: {'PASS' if ok else 'FAIL'} "
@@ -262,6 +464,9 @@ def run_smoke(out_dir: str) -> int:
 
 def main() -> int:
     args = sys.argv[1:]
+    if "--merge-leg" in args:
+        print("MERGELEG=" + json.dumps(merge_leg()))
+        return 0
     if "--out" in args:
         i = args.index("--out")
         if i + 1 >= len(args):

@@ -1,0 +1,682 @@
+"""PR-11 Relay-class optimizer surface (docs/GRAPH_PASSES.md):
+activation fusion, conv+1x1 merging, common-subexpression sharing,
+the per-layer autotuner plans (tuning-cache schema v2 + migration),
+the telemetry-shaped serve bucket ladder, and multi-batch fold
+calibration."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet import passes, tuning
+from cxxnet_tpu.nnet.passes import PassPipeline
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.serve import (bucket_sizes, ladder_buckets,
+                              ladder_from_histogram)
+from cxxnet_tpu.utils.config import ConfigError, parse_config_string
+
+ACT_CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+0] = bias:bs1
+  init_bias = 0.05
+layer[+1:r1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,36
+batch_size = 8
+dev = cpu
+eta = 0.1
+silent = 1
+seed = 7
+"""
+
+MERGE_CONF = """
+netconfig=start
+layer[+1:c1] = conv:c1
+  nchannel = 4
+  kernel_size = 3
+  pad = 1
+layer[+1:c2] = conv:c2
+  nchannel = 6
+  kernel_size = 1
+layer[+1:r1] = relu
+layer[+1:fl] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 8
+dev = cpu
+eta = 0.1
+silent = 1
+seed = 5
+"""
+
+FOLD_MERGE_CONF = MERGE_CONF.replace(
+    "layer[+1:c2] = conv:c2",
+    "layer[+1:b1] = batch_norm:b1\nlayer[+1:c2] = conv:c2")
+
+CSE_CONF = """
+netconfig=start
+layer[0->a] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[0->b] = share[fc1]
+layer[a,b->c] = concat
+layer[+1:fc2] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,12
+batch_size = 8
+dev = cpu
+eta = 0.1
+silent = 1
+seed = 3
+"""
+
+# two DISTINCT primaries with identical configs: same function shape,
+# but equal weights cannot be proven - must NOT dedupe
+CSE_DISTINCT_CONF = CSE_CONF.replace(
+    "layer[0->b] = share[fc1]",
+    "layer[0->b] = fullc:fc1b\n  nhidden = 8\n  init_sigma = 0.1")
+
+BN_MLP_CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:bn1] = batch_norm:bn1
+layer[+1:sg1] = tanh
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,36
+batch_size = 8
+dev = cpu
+eta = 0.1
+silent = 1
+seed = 11
+"""
+
+
+def _build(conf, extra=""):
+    tr = NetTrainer()
+    for k, v in parse_config_string(conf + extra):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def _batch(i, b=8, shape=(1, 1, 36), nclass=3):
+    r = np.random.RandomState(500 + i)
+    return DataBatch(
+        data=r.rand(b, *shape).astype(np.float32),
+        label=r.randint(0, nclass, size=(b, 1)).astype(np.float32))
+
+
+def _train_pair(conf, passes_arg, shape=(1, 1, 36), steps=3):
+    off = _build(conf)
+    on = _build(conf, f"graph_passes = {passes_arg}\n")
+    for i in range(steps):
+        off.update(_batch(i, shape=shape))
+        on.update(_batch(i, shape=shape))
+    return off, on
+
+
+def _prims(tr, shape):
+    node = tr.net_cfg.num_nodes - 1
+    g, ge = tr.stage_infer_rows(np.zeros((8,) + shape, np.float32))
+    eqns = tr._infer_fn(node).trace(
+        tr.state["params"], g, ge).jaxpr.jaxpr.eqns
+    out = {}
+    for e in eqns:
+        out[e.primitive.name] = out.get(e.primitive.name, 0) + 1
+    return len(eqns), out
+
+
+# ---------------------------------------------------------------------------
+# fuse_activation
+# ---------------------------------------------------------------------------
+def test_act_fusion_parity_and_smaller_trace():
+    off, on = _train_pair(ACT_CONF,
+                          "dead_layer_elim,fuse_activation")
+    b = _batch(50)
+    po, pn = off.predict_dist(b), on.predict_dist(b)
+    assert np.allclose(po, pn, rtol=1e-5, atol=1e-6)
+    assert (po.argmax(1) == pn.argmax(1)).all()
+    eo, po_ = _prims(off, (1, 1, 36))
+    en, pn_ = _prims(on, (1, 1, 36))
+    # strictly fewer eqns, equal matmul count (the pass-audit claim)
+    assert en < eo
+    assert pn_["dot_general"] == po_["dot_general"]
+    gm = on._build_infer_graph(on.net_cfg.num_nodes - 1)[2]
+    assert gm.act_fuses and gm.act_fuses[0].bias_keys == ["bs1"]
+    assert any("fuse_activation" in line for line in gm.log)
+
+
+def test_act_fusion_relu_only_parity():
+    conf = ACT_CONF.replace(
+        "layer[+0] = bias:bs1\n  init_bias = 0.05\n", "")
+    off, on = _train_pair(conf, "dead_layer_elim,fuse_activation")
+    b = _batch(51)
+    po, pn = off.predict_dist(b), on.predict_dist(b)
+    # relu-only fusion reorders nothing: bitwise
+    assert (po == pn).all()
+
+
+def test_act_fusion_skips_when_intermediate_is_target():
+    _off, on = _train_pair(ACT_CONF,
+                           "dead_layer_elim,fuse_activation")
+    # extracting the raw fc1 output (pre-bias) must keep the chain
+    # unfused on that executable
+    b = _batch(52)
+    raw_on = on.extract_feature(b, "fc1")
+    off = _build(ACT_CONF)
+    buf = io.BytesIO()
+    on.save_model(buf)
+    buf.seek(0)
+    off.copy_model_from(buf)
+    raw_off = off.extract_feature(b, "fc1")
+    assert np.allclose(raw_on, raw_off, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_act_rejects_bad_value():
+    from cxxnet_tpu.layers.common import (ConvolutionLayer,
+                                          FullConnectLayer)
+    for lay in (ConvolutionLayer(), FullConnectLayer()):
+        with pytest.raises(ValueError, match="fused_act"):
+            lay.set_param("fused_act", "tanh")
+
+
+# ---------------------------------------------------------------------------
+# merge_conv_1x1
+# ---------------------------------------------------------------------------
+def test_merge_1x1_parity_and_one_conv_fewer():
+    off, on = _train_pair(MERGE_CONF,
+                          "dead_layer_elim,merge_conv_1x1",
+                          shape=(3, 8, 8))
+    b = _batch(60, shape=(3, 8, 8))
+    po, pn = off.predict_dist(b), on.predict_dist(b)
+    assert np.allclose(po, pn, rtol=5e-4, atol=1e-6)
+    _eo, po_ = _prims(off, (3, 8, 8))
+    _en, pn_ = _prims(on, (3, 8, 8))
+    assert po_["conv_general_dilated"] == 2
+    assert pn_["conv_general_dilated"] == 1
+
+
+def test_merge_tracks_live_weights():
+    """The merged W' = W2 . W1 is computed in-jit from the LIVE
+    params: a set_weight on either conv is picked up without any
+    rebuild."""
+    _off, on = _train_pair(MERGE_CONF,
+                           "dead_layer_elim,merge_conv_1x1",
+                           shape=(3, 8, 8))
+    b = _batch(61, shape=(3, 8, 8))
+    before = on.predict_dist(b)
+    w, _shape = on.get_weight("c2", "wmat")
+    on.set_weight(w * 2.0, "c2", "wmat")
+    after = on.predict_dist(b)
+    assert not np.allclose(before, after)
+    fresh = _build(MERGE_CONF)
+    buf = io.BytesIO()
+    on.save_model(buf)
+    buf.seek(0)
+    fresh.copy_model_from(buf)
+    expect = fresh.predict_dist(b)
+    assert np.allclose(after, expect, rtol=5e-4, atol=1e-6)
+
+
+def test_merge_excluded_for_shared_weights_and_multi_consumer():
+    # second conv shared: folding it would specialize shared weights
+    shared = """
+netconfig=start
+layer[+1:c1] = conv:c1
+  nchannel = 4
+  kernel_size = 1
+layer[+1:c2] = conv:c2
+  nchannel = 4
+  kernel_size = 1
+layer[+1] = share[c2]
+layer[+1:fl] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,4,4
+batch_size = 4
+dev = cpu
+eta = 0.1
+silent = 1
+"""
+    tr = _build(shared)
+    assert passes.find_merge_site(tr.net_cfg, None) is None
+    # multi-consumer intermediate: another reader needs the raw value
+    multi = MERGE_CONF.replace(
+        "layer[+1:r1] = relu",
+        "layer[c1->s1,s2] = split\nlayer[s1->r1] = relu")
+    # c1's node now feeds a split BEFORE c2... rebuild: c2 reads c1
+    multi = """
+netconfig=start
+layer[+1:c1] = conv:c1
+  nchannel = 4
+  kernel_size = 3
+  pad = 1
+layer[c1_out->x1] = conv:c2
+  nchannel = 6
+  kernel_size = 1
+layer[c1_out->x2] = relu
+layer[x1->fl] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 4
+dev = cpu
+eta = 0.1
+silent = 1
+"""
+    multi = multi.replace("layer[+1:c1] = conv:c1",
+                          "layer[0->c1_out] = conv:c1")
+    tr2 = _build(multi)
+    assert passes.find_merge_site(tr2.net_cfg, None) is None
+
+
+def test_merge_respects_layer_dtype_pin():
+    """A `layer_dtype = float32` pin on the 1x1 conv under bf16
+    autocast must BLOCK the merge - the merged conv would run at the
+    first conv's bf16 and silently override the explicit pin
+    (explicit-keys-always-win; regression)."""
+    pinned = MERGE_CONF.replace(
+        "  kernel_size = 1",
+        "  kernel_size = 1\n  layer_dtype = float32")
+    on = _build(pinned + "dtype = bfloat16\n",
+                "graph_passes = autocast,merge_conv_1x1\n")
+    gm = on._build_infer_graph(on.net_cfg.num_nodes - 1)[2]
+    assert not any("merge_conv_1x1" in line for line in gm.log)
+    # vacuity control: without the pin the same net merges (both
+    # convs carry the same bf16 stamp)
+    on2 = _build(MERGE_CONF + "dtype = bfloat16\n",
+                 "graph_passes = autocast,merge_conv_1x1\n")
+    gm2 = on2._build_infer_graph(on2.net_cfg.num_nodes - 1)[2]
+    assert any("merge_conv_1x1" in line for line in gm2.log)
+
+
+def test_fold_then_merge_then_fuse_compose():
+    """conv -> bn -> 1x1 conv -> relu: the fold, the merge and the
+    activation stamp all land on ONE conv, with the staged param
+    function composing the transforms."""
+    off, on = _train_pair(
+        FOLD_MERGE_CONF,
+        "dead_layer_elim,fold_conv_bn,merge_conv_1x1,fuse_activation",
+        shape=(3, 8, 8))
+    b = _batch(62, shape=(3, 8, 8))
+    po = off.predict_dist(b)
+    pn = on.predict_dist(b)  # calibrates fold on this batch
+    assert np.allclose(po, pn, rtol=5e-4, atol=1e-5)
+    _en, pn_ = _prims(on, (3, 8, 8))
+    assert pn_["conv_general_dilated"] == 1
+    assert "rsqrt" not in str(
+        on._infer_fn(on.net_cfg.num_nodes - 1).trace(
+            on.state["params"],
+            *on.stage_infer_rows(np.zeros((8, 3, 8, 8),
+                                          np.float32))).jaxpr)
+
+
+def test_fold_on_second_conv_composes_with_merge():
+    """conv -> 1x1 conv -> bn: the fold lands on the SECOND conv, so
+    the merge stage must contract the FOLDED 1x1 weights (live view)
+    - reading the raw params would silently drop the BN scale/shift
+    from the merged conv (regression)."""
+    conf = MERGE_CONF.replace(
+        "layer[+1:r1] = relu",
+        "layer[+1:b2] = batch_norm:b2\nlayer[+1:r1] = relu")
+    off, on = _train_pair(
+        conf, "dead_layer_elim,fold_conv_bn,merge_conv_1x1",
+        shape=(3, 8, 8))
+    b = _batch(63, shape=(3, 8, 8))
+    po = off.predict_dist(b)
+    pn = on.predict_dist(b)  # calibrates fold on this batch
+    assert np.allclose(po, pn, rtol=5e-4, atol=1e-5)
+    _en, pn_ = _prims(on, (3, 8, 8))
+    assert pn_["conv_general_dilated"] == 1
+
+
+# ---------------------------------------------------------------------------
+# cse_share
+# ---------------------------------------------------------------------------
+def test_cse_dedupes_share_sibling_bitwise():
+    off, on = _train_pair(CSE_CONF, "dead_layer_elim,cse_share",
+                          shape=(1, 1, 12))
+    b = _batch(70, shape=(1, 1, 12))
+    po, pn = off.predict_dist(b), on.predict_dist(b)
+    # the duplicate computes the identical value; dedupe is bitwise
+    assert (po == pn).all()
+    _eo, po_ = _prims(off, (1, 1, 12))
+    _en, pn_ = _prims(on, (1, 1, 12))
+    assert pn_["dot_general"] == po_["dot_general"] - 1
+
+
+def test_cse_must_not_dedupe_distinct_params():
+    off, on = _train_pair(CSE_DISTINCT_CONF,
+                          "dead_layer_elim,cse_share",
+                          shape=(1, 1, 12))
+    _eo, po_ = _prims(off, (1, 1, 12))
+    _en, pn_ = _prims(on, (1, 1, 12))
+    # fc1 and fc1b own distinct weights: equal dots, nothing deduped
+    assert pn_["dot_general"] == po_["dot_general"]
+    gm = on._build_infer_graph(on.net_cfg.num_nodes - 1)[2]
+    assert not any("cse_share" in line for line in gm.log)
+
+
+def test_cse_dedupes_paramless_siblings():
+    conf = CSE_CONF.replace("layer[0->b] = share[fc1]",
+                            "layer[a->t1] = tanh\nlayer[a->t2] = tanh")
+    conf = conf.replace("layer[a,b->c] = concat",
+                        "layer[t1,t2->c] = concat")
+    off, on = _train_pair(conf, "dead_layer_elim,cse_share",
+                          shape=(1, 1, 12))
+    b = _batch(71, shape=(1, 1, 12))
+    assert (off.predict_dist(b) == on.predict_dist(b)).all()
+    gm = on._build_infer_graph(on.net_cfg.num_nodes - 1)[2]
+    assert any("cse_share" in line for line in gm.log)
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration
+# ---------------------------------------------------------------------------
+def test_canonical_order_and_all_includes_new_passes():
+    pl = PassPipeline.from_config("all")
+    names = pl.names()
+    for n in ("cse_share", "merge_conv_1x1", "fuse_activation"):
+        assert n in names
+    assert names.index("dead_layer_elim") < names.index("cse_share")
+    assert names.index("cse_share") < names.index("fold_conv_bn")
+    assert names.index("fold_conv_bn") < names.index("merge_conv_1x1")
+    assert names.index("merge_conv_1x1") < names.index(
+        "fuse_activation")
+
+
+def test_checkpoint_bytes_identical_with_all_passes():
+    """All infer-stage passes on vs off: the training trajectory and
+    the checkpoint bytes are untouched."""
+    off, on = _train_pair(BN_MLP_CONF, "all", steps=4)
+    on.predict(_batch(80))  # calibrate + build the transformed graph
+    bo, bn_ = io.BytesIO(), io.BytesIO()
+    off.save_model(bo)
+    on.save_model(bn_)
+    assert bo.getvalue() == bn_.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# tuning cache v2: plans, ladder, migration
+# ---------------------------------------------------------------------------
+def test_cache_v2_roundtrip_plan_and_ladder(tmp_path):
+    p = str(tmp_path / "tc.json")
+    tuning.save_entry(p, "cpu", {"steps_per_dispatch": 2},
+                      layers={"c1": {"space_to_depth": "1"},
+                              "fc6": {"layer_dtype": "float32"}},
+                      serve_ladder=[2, 6, 16])
+    assert tuning.tuned_layer_plan(p, "cpu") == {
+        "c1": {"space_to_depth": "1"},
+        "fc6": {"layer_dtype": "float32"}}
+    assert tuning.tuned_serve_ladder(p, "cpu") == [2, 6, 16]
+    assert tuning.tuned_layer_plan(p, "tpu") == {}
+    assert tuning.tuned_serve_ladder(p, "tpu") is None
+    with open(p) as f:
+        assert json.load(f)["version"] == 2
+
+
+def test_cache_v1_one_shot_migration(tmp_path):
+    p = str(tmp_path / "v1.json")
+    with open(p, "w") as f:
+        json.dump({"version": 1, "platforms": {
+            "cpu": {"knobs": {"steps_per_dispatch": 4}}}}, f)
+    blob = tuning.load_cache(p)
+    assert blob["version"] == 2
+    assert blob["platforms"]["cpu"]["layers"] == {}
+    assert tuning.tuned_knobs(p, "cpu") == {"steps_per_dispatch": "4"}
+    # on-disk file untouched (migration is in-memory)
+    with open(p) as f:
+        assert json.load(f)["version"] == 1
+
+
+def test_cache_garbage_still_raises(tmp_path):
+    cases = [
+        {"version": 3, "platforms": {}},
+        {"version": "two", "platforms": {}},
+        {"version": 2, "platforms": {"cpu": {"layers": ["x"]}}},
+        {"version": 2, "platforms": {
+            "cpu": {"layers": {"c1": {"bogus_knob": 1}}}}},
+        {"version": 2, "platforms": {"cpu": {"serve_ladder": [0]}}},
+        {"version": 2, "platforms": {
+            "cpu": {"serve_ladder": [8, 4]}}},
+        {"version": 2, "platforms": {
+            "cpu": {"serve_ladder": "2,4"}}},
+    ]
+    for payload in cases:
+        p = str(tmp_path / "bad.json")
+        with open(p, "w") as f:
+            json.dump(payload, f)
+        with pytest.raises(ConfigError):
+            tuning.load_cache(p)
+    with pytest.raises(ValueError, match="untunable per-layer"):
+        tuning.save_entry(str(tmp_path / "x.json"), "cpu", {},
+                          layers={"c1": {"nope": "1"}})
+
+
+def test_trainer_applies_layer_plan_and_explicit_wins(tmp_path):
+    p = str(tmp_path / "tc.json")
+    tuning.save_entry(p, "cpu", {},
+                      layers={"fc1": {"layer_dtype": "float32"},
+                              "nosuch": {"layer_dtype": "float32"},
+                              "bn1": {"space_to_depth": "1"}})
+    tr = _build(BN_MLP_CONF, f"tuning_cache = {p}\n")
+    idx = tr.net_cfg.layer_name_map["fc1"]
+    assert ("layer_dtype", "float32") in tr.net_cfg.layercfg[idx]
+    # s2d on a non-conv layer is inapplicable: skipped silently
+    bidx = tr.net_cfg.layer_name_map["bn1"]
+    assert not any(k == "space_to_depth"
+                   for k, _ in tr.net_cfg.layercfg[bidx])
+    # explicit per-layer key wins over the plan
+    conf2 = BN_MLP_CONF.replace(
+        "  nhidden = 16",
+        "  nhidden = 16\n  layer_dtype = bfloat16")
+    tr2 = _build(conf2, f"tuning_cache = {p}\n")
+    idx2 = tr2.net_cfg.layer_name_map["fc1"]
+    vals = [v for k, v in tr2.net_cfg.layercfg[idx2]
+            if k == "layer_dtype"]
+    assert vals == ["bfloat16"]
+
+
+def test_trainer_layer_plan_drives_autocast_dtype_plan(tmp_path):
+    p = str(tmp_path / "tc.json")
+    tuning.save_entry(p, "cpu", {},
+                      layers={"fc1": {"layer_dtype": "float32"}})
+    import jax.numpy as jnp
+    tr = _build(BN_MLP_CONF,
+                f"dtype = bfloat16\ngraph_passes = autocast\n"
+                f"tuning_cache = {p}\n")
+    idx = tr.net_cfg.layer_name_map["fc1"]
+    assert tr._graph_dtype_plan[idx] == jnp.float32
+    idx2 = tr.net_cfg.layer_name_map["fc2"]
+    assert tr._graph_dtype_plan[idx2] == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# serve bucket ladder
+# ---------------------------------------------------------------------------
+def test_ladder_from_histogram_shapes_buckets():
+    hist = {3: 50, 7: 30, 12: 15, 30: 5}
+    lad = ladder_from_histogram(hist, 32, data_axis=1, rungs=4)
+    assert lad[-1] == 32
+    assert 3 in lad and 7 in lad
+    assert all(lad[i] < lad[i + 1] for i in range(len(lad) - 1))
+    # data-axis rounding: every rung divisible by the axis
+    lad2 = ladder_from_histogram(hist, 32, data_axis=4, rungs=4)
+    assert all(b % 4 == 0 for b in lad2)
+    # empty histogram falls back to the power-of-two set
+    assert ladder_from_histogram({}, 16) == bucket_sizes(16)
+
+
+def test_ladder_buckets_drops_inapplicable_rungs():
+    assert ladder_buckets([2, 3, 8, 64], 16, data_axis=2) == (2, 8, 16)
+    with pytest.raises(ValueError, match="multiple"):
+        ladder_buckets([2], 15, data_axis=2)
+
+
+def test_server_uses_trainer_ladder_and_counts_sizes():
+    from cxxnet_tpu.serve import Server
+    tr = _build(BN_MLP_CONF, "serve_bucket_ladder = 2,6\n")
+    assert tr.serve_ladder == [2, 6]
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1)
+    assert srv.buckets == (2, 6, 8)
+    srv.warmup()
+    srv.start()
+    try:
+        r = np.random.RandomState(0)
+        for n in (1, 5, 5):
+            srv.submit(r.rand(n, 1, 1, 36).astype(np.float32)) \
+               .result(timeout=60)
+    finally:
+        stats = srv.stop()
+    assert stats["request_sizes"] == {1: 1, 5: 2}
+
+
+def test_server_ladder_from_cache_and_explicit_wins(tmp_path):
+    from cxxnet_tpu.serve import Server
+    p = str(tmp_path / "tc.json")
+    tuning.save_entry(p, "cpu", {}, serve_ladder=[2, 4])
+    tr = _build(BN_MLP_CONF, f"tuning_cache = {p}\n")
+    assert tr.serve_ladder == [2, 4]
+    assert Server(tr, max_batch=8).buckets == (2, 4, 8)
+    # explicit serve_bucket_ladder beats the cache
+    tr2 = _build(BN_MLP_CONF,
+                 f"serve_bucket_ladder = 3,6\ntuning_cache = {p}\n")
+    assert tr2.serve_ladder == [3, 6]
+    assert Server(tr2, max_batch=8).buckets == (3, 6, 8)
+
+
+def test_serve_bucket_ladder_validation():
+    tr = NetTrainer()
+    with pytest.raises(ValueError, match="serve_bucket_ladder"):
+        tr.set_param("serve_bucket_ladder", "4,2")
+    with pytest.raises(ValueError, match="serve_bucket_ladder"):
+        tr.set_param("serve_bucket_ladder", "0,2")
+
+
+# ---------------------------------------------------------------------------
+# multi-batch fold calibration
+# ---------------------------------------------------------------------------
+def test_single_batch_calibration_unchanged_by_sequence_form():
+    on1 = _build(BN_MLP_CONF, "graph_passes = fold_conv_bn\n")
+    on2 = _build(BN_MLP_CONF, "graph_passes = fold_conv_bn\n")
+    b = _batch(90)
+    on1.calibrate_graph_passes(b)
+    on2.calibrate_graph_passes([b])
+    m1, r1 = on1._fold_stats["bn1"]
+    m2, r2 = on2._fold_stats["bn1"]
+    # one-element sequence rides the pinned single-batch path: bitwise
+    assert (np.asarray(m1) == np.asarray(m2)).all()
+    assert (np.asarray(r1) == np.asarray(r2)).all()
+
+
+def test_multi_batch_calibration_pools_moments():
+    on = _build(BN_MLP_CONF, "graph_passes = fold_conv_bn\n")
+    batches = [_batch(91), _batch(92), _batch(93)]
+    assert on.calibrate_graph_passes(batches)
+    mean, rstd = on._fold_stats["bn1"]
+    # reference pooled moments over the concatenated calibration set
+    single = _build(BN_MLP_CONF, "graph_passes = fold_conv_bn\n")
+    big = DataBatch(
+        data=np.concatenate([b.data for b in batches]),
+        label=np.concatenate([b.label for b in batches]))
+    # equal-sized batches: pooled mean == mean of per-batch means,
+    # pooled var == mean(E[x^2]) - mean^2 - compare against direct
+    # stats over the fc1 activations of the union
+    w = np.asarray(single.state["params"]["fc1"]["wmat"])
+    bias = np.asarray(single.state["params"]["fc1"]["bias"])
+    # both trainers share the seed, so fc1 weights are identical
+    acts = big.data.reshape(24, -1) @ w.T + bias
+    assert np.allclose(mean, acts.mean(0), rtol=1e-4, atol=1e-5)
+    var = acts.var(0)
+    eps = on.net.layer_objs[1].eps
+    assert np.allclose(rstd, 1.0 / np.sqrt(var + eps), rtol=1e-3,
+                       atol=1e-4)
+    # parity: folded predict stays close to unfolded on a member batch
+    off = _build(BN_MLP_CONF)
+    pn = on.predict_dist(batches[0])
+    po = off.predict_dist(batches[0])
+    assert np.allclose(po, pn, rtol=0.2, atol=0.05)
+
+
+def test_multi_batch_calibration_masks_padding_rows():
+    """A round_batch=0 iterator zero-fills its tail batch; those
+    padding rows must not drag the pooled frozen stats toward zero
+    (regression: the mask was computed and discarded)."""
+    on = _build(BN_MLP_CONF, "graph_passes = fold_conv_bn\n")
+    full = _batch(94)
+    short = _batch(95)
+    padded = DataBatch(
+        data=np.concatenate([short.data[:5],
+                             np.zeros_like(short.data[:3])]),
+        label=short.label.copy(), num_batch_padd=3)
+    assert on.calibrate_graph_passes([full, padded])
+    mean, rstd = on._fold_stats["bn1"]
+    # reference: direct moments over the 13 REAL rows only (the
+    # valid-row-weighted pooling of exact per-batch moments IS the
+    # union statistic)
+    ref = _build(BN_MLP_CONF, "graph_passes = fold_conv_bn\n")
+    w = np.asarray(ref.state["params"]["fc1"]["wmat"])
+    bias = np.asarray(ref.state["params"]["fc1"]["bias"])
+    real = np.concatenate([full.data,
+                           padded.data[:5]]).reshape(13, -1)
+    acts = real @ w.T + bias
+    eps = on.net.layer_objs[1].eps
+    assert np.allclose(mean, acts.mean(0), rtol=1e-4, atol=1e-5)
+    assert np.allclose(rstd, 1.0 / np.sqrt(acts.var(0) + eps),
+                       rtol=1e-3, atol=1e-4)
+
+
+def test_pass_calibration_batches_key_validated():
+    tr = NetTrainer()
+    tr.set_param("pass_calibration_batches", "3")
+    assert tr.pass_calibration_batches == 3
+    with pytest.raises(ValueError):
+        tr.set_param("pass_calibration_batches", "0")
+    # the pass_ prefix toggle handler must NOT swallow it as a pass
+    assert "calibration_batches" not in tr._pass_toggles
+
+
+# ---------------------------------------------------------------------------
+# config schema: new keys registered with did-you-mean
+# ---------------------------------------------------------------------------
+def test_schema_registers_new_keys():
+    from cxxnet_tpu.analysis import schema
+    reg = schema.build_registry()
+    for key in ("serve_bucket_ladder", "pass_calibration_batches",
+                "pass_calibration_iter", "fused_act",
+                "pass_cse_share", "pass_merge_conv_1x1",
+                "pass_fuse_activation"):
+        assert reg.recognizes(key), key
+    assert reg.suggest("serve_bucket_ladderr") == "serve_bucket_ladder"
+    with pytest.raises(ConfigError, match="serve_bucket_ladder"):
+        schema.validate_pairs([("serve_bucket_ladderr", "2,4")],
+                              source="x.conf")
