@@ -1110,6 +1110,122 @@ def _bench_fold(ctx) -> dict:
         return {"fold_error": f"{type(e).__name__}: {e}"}
 
 
+# int8 PTQ workload: a weight-bound wide-fullc MLP at a SERVING-shaped
+# small batch - the regime the quantize_int8 pass exists for
+# (docs/GRAPH_PASSES.md "when int8 loses": large batches go
+# compute-bound and int8's extra quant/dequant work outweighs the
+# weight-bandwidth saving; measured on this container's XLA:CPU the
+# crossover sits between batch 16 and 64)
+_INT8_MLP_CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 2048
+  init_sigma = 0.05
+layer[+1:bn1] = batch_norm:bn1
+layer[+1:r1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 2048
+  init_sigma = 0.05
+layer[+1:bn2] = batch_norm:bn2
+layer[+1:r2] = relu
+layer[+1:fc3] = fullc:fc3
+  nhidden = 10
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,512
+dev = cpu
+eta = 0.1
+silent = 1
+seed = 19
+"""
+
+# fwd FLOP lower bound for the int8 MLP: 512*2048 + 2048*2048 +
+# 2048*10 MACs ~ 10.5 MFLOP/img; low end on purpose (an
+# under-estimate only loosens the physics cap)
+_INT8_MLP_FWD_GFLOP_PER_IMG = 0.01
+
+# fixed serving-shaped batch for the int8 pair: ctx.batch is the
+# TRAINING workload size; quantized inference's claim is the
+# small-batch weight-bound serving regime
+_INT8_BATCH = 16
+
+
+def _bench_int8(ctx) -> dict:
+    """Int8 post-training-quantized inference (quantize_int8 pass +
+    ops/int8.py kernels - docs/GRAPH_PASSES.md "Quantization") vs the
+    folded-float pipeline, on a weight-bound wide-fullc MLP at a
+    serving-shaped batch: the SAME predict_dist loop over the SAME
+    rows in the same window, so `int8_over_fold` prices exactly what
+    quantization changes - int8 weight traffic + MXU/VNNI-rate
+    contraction against the extra quantize/dequantize elementwise
+    work. >1.0 = int8 won. The speed claim ships with its accuracy
+    cost: `int8_argmax_agree` is the fraction of a fixed 256-row
+    synthetic eval set where the quantized argmax matches the float
+    one (1.0 = no prediction changed). Calibration (one batch)
+    happens in warmup, outside the timed window, like the fold leg.
+    Disable with CXN_BENCH_INT8=0."""
+    if os.environ.get("CXN_BENCH_INT8") == "0":
+        return {}
+    try:
+        from cxxnet_tpu.io.data import DataBatch
+        from cxxnet_tpu.nnet.trainer import NetTrainer
+        from cxxnet_tpu.utils.config import parse_config_string
+        batch = _INT8_BATCH
+
+        def build(extra=""):
+            tr = NetTrainer()
+            for k, v in parse_config_string(
+                    _INT8_MLP_CONF + f"batch_size = {batch}\n"
+                    "graph_passes = dead_layer_elim,fold_conv_bn,"
+                    "fuse_activation" + extra):
+                tr.set_param(k, v)
+            tr.init_model()
+            return tr
+
+        rng = np.random.RandomState(41)
+        db = DataBatch(
+            data=rng.rand(batch, 1, 1, 512).astype(np.float32),
+            label=rng.randint(0, 10, (batch, 1)).astype(np.float32))
+
+        def ips_of(tr, budget_s=20.0):
+            tr.predict_dist(db)  # compile (+ calibration)
+            t0 = time.perf_counter()
+            tr.predict_dist(db)
+            per = max(time.perf_counter() - t0, 1e-6)
+            n = max(3, min(256, int(budget_s / per)))
+            t0 = time.perf_counter()
+            for _ in range(n):
+                tr.predict_dist(db)
+            return n * batch / (time.perf_counter() - t0), n
+
+        fold_tr, int8_tr = build(), build(",quantize_int8")
+        folded, n1 = ips_of(fold_tr)
+        int8, n2 = ips_of(int8_tr)
+        # accuracy delta on a fixed held-out set (same weights, same
+        # rows): argmax agreement between the two inference paths
+        agree = total = 0
+        for i in range(256 // batch):
+            r = np.random.RandomState(900 + i)
+            eb = DataBatch(
+                data=r.rand(batch, 1, 1, 512).astype(np.float32),
+                label=r.randint(0, 10, (batch, 1)).astype(np.float32))
+            pf = fold_tr.predict_dist(eb).argmax(axis=1)
+            pq = int8_tr.predict_dist(eb).argmax(axis=1)
+            agree += int((pf == pq).sum())
+            total += batch
+        out = {"int8_infer_ips": round(int8, 2),
+               "int8_fold_ips": round(folded, 2),
+               "int8_batch": batch,
+               "int8_steps": n1 + n2,
+               "int8_argmax_agree": round(agree / max(total, 1), 4)}
+        if folded > 0:
+            out["int8_over_fold"] = round(int8 / folded, 4)
+        return out
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"int8_error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_plan(ctx) -> dict:
     """The PER-LAYER autotuner's value proposition, measured
     (schema-v2 tuning_cache, docs/GRAPH_PASSES.md "per-layer
@@ -1405,6 +1521,7 @@ _MEASUREMENTS = (
     ("zero", _bench_zero, "CXN_BENCH_ZERO", 150, "h2d"),
     ("serve", _bench_serve, "CXN_BENCH_SERVE", 150, "h2d"),
     ("fold", _bench_fold, "CXN_BENCH_FOLD", 150, "h2d"),
+    ("int8", _bench_int8, "CXN_BENCH_INT8", 150, "h2d"),
     ("autotune", _bench_autotune, "CXN_BENCH_AUTOTUNE", 150, "h2d"),
     ("plan", _bench_plan, "CXN_BENCH_PLAN", 150, "h2d"),
     ("attention",
@@ -1457,6 +1574,8 @@ _GFLOP_PER_IMG = {
     # fwd-FLOP lower bounds, same under-estimate convention
     "fold_infer_ips": BN_CONVNET_FWD_GFLOP_PER_IMG,
     "fold_unfolded_ips": BN_CONVNET_FWD_GFLOP_PER_IMG,
+    "int8_infer_ips": _INT8_MLP_FWD_GFLOP_PER_IMG,
+    "int8_fold_ips": _INT8_MLP_FWD_GFLOP_PER_IMG,
     "autotune_best_ips": AUTOTUNE_MLP_GFLOP_PER_IMG,
     "autotune_default_ips": AUTOTUNE_MLP_GFLOP_PER_IMG,
     # per-layer-plan family runs the BN-convnet forward
@@ -1542,6 +1661,11 @@ def _derive(out: dict, batch: int, platform: str, ndev: int,
     # base number takes its ratio with it
     if not out.get("fold_infer_ips"):
         out.pop("fold_over_infer", None)
+    if not out.get("int8_infer_ips"):
+        # the speed ratio AND its accuracy cost travel together: an
+        # agreement number without the run it came from is meaningless
+        out.pop("int8_over_fold", None)
+        out.pop("int8_argmax_agree", None)
     if not out.get("autotune_best_ips"):
         out.pop("tuned_over_default", None)
     if not out.get("plan_tuned_ips"):
@@ -1684,6 +1808,7 @@ _LAST_GOOD_MAX_FIELDS = (
     "compute_ips", "e2e_ips", "e2e_devicedata_ips", "e2e_prefetch_ips",
     "e2e_fused_ips", "zero2_ips", "serve_qps", "serve_rows_per_s",
     "fold_infer_ips", "fold_over_infer",
+    "int8_infer_ips", "int8_over_fold",
     "autotune_best_ips", "tuned_over_default",
     "plan_tuned_ips", "plan_over_default",
     "compute_poolties_ips", "googlenet_ips", "googlenet_devicedata_ips",
@@ -1773,6 +1898,8 @@ _SYNC_SOURCE = {
     "serve_over_predict": "serve",
     "fold_infer_ips": "fold", "fold_unfolded_ips": "fold",
     "fold_over_infer": "fold",
+    "int8_infer_ips": "int8", "int8_fold_ips": "int8",
+    "int8_over_fold": "int8", "int8_argmax_agree": "int8",
     "autotune_best_ips": "autotune",
     "autotune_default_ips": "autotune",
     "tuned_over_default": "autotune",

@@ -66,13 +66,28 @@ the jaxpr + StableHLO + compiled HLO:
   padded predicts and extracts leave every per-node infer cache at
   exactly 1 (the recompile audit stays flat).
 
+- **quant-audit**: the int8 post-training-quantization path
+  (quantize_int8 pass + ops/int8.py, docs/GRAPH_PASSES.md
+  "Quantization") audited at the traced-program level: the quantized
+  infer trace's DATA-PATH matmuls (output leading dim = the batch)
+  all carry int8 operand dtypes with int32 accumulation and ZERO
+  float data-path dots remain, vacuity-guarded against the float
+  trace (which must carry the f32 dots, or the comparison proves
+  nothing - the GRAPH_PASSES.md key finding that wins are measured
+  at the traced-jaxpr level); an explicit `layer_quant = float` pin
+  keeps exactly its layer's dot float; and quantized SERVING stays
+  zero-recompile - calibrate first, then a warmed Server's
+  executable count equals the bucket count and stays flat over a
+  mixed-size request storm, with each bucket executable's trace
+  int8-engaged.
+
 Audited executables: `train_step`, `_train_chunk` (K=1 and K=4), the
 eval pair (`eval_step`, `eval_metric_step`) and the dedicated
 `infer_step` (predict/extract/serve share it), over the tiny-MLP
 config the fused-dispatch smoke uses, plus the zero-audit set
 (stage-2 `train_step`/`_train_chunk[K=4]` on `data:8`, stage-3
 `train_step` on `data:8`, stage-2 `train_step` on `data:4,model:2`),
-the serve bucket set and the pass-audit pair.
+the serve bucket set, the pass-audit pair and the quant-audit set.
 Run under `JAX_PLATFORMS=cpu` in CI; the checks are artifact-level,
 so they hold for any backend that compiles the same programs.
 """
@@ -699,6 +714,148 @@ def _new_pattern_audit(checks: List[Dict[str, Any]]) -> None:
         f"{e_off} undeduped (want one dot fewer; the undeduped "
         "trace must carry the duplicate - vacuity guard)"))
 
+    # elim_reshape: the flatten layer's reshape equation disappears,
+    # matmul/conv counts unchanged (pure graph cleanup)
+    e_off, p_off, gm_off, e_on, p_on, gm_on = traces(
+        _CONF_1X1, "elim_reshape", (8, 3, 8, 8))
+    ro = p_off.get("reshape", 0)
+    rn = p_on.get("reshape", 0)
+    checks.append(_check(
+        "passes/elim_reshape", "fewer-eqns-equal-matmuls",
+        e_on < e_off and rn == ro - 1 and ro >= 1
+        and p_on.get("dot_general", 0) == p_off.get("dot_general", 0)
+        and p_on.get("conv_general_dilated", 0)
+        == p_off.get("conv_general_dilated", 0)
+        and len(gm_on.cfg.layers) < len(gm_off.cfg.layers),
+        f"elim trace carries {rn} reshapes/{e_on} eqns vs {ro}/"
+        f"{e_off} (want one reshape fewer at equal matmul/conv "
+        "counts; the off trace must carry the flatten - vacuity "
+        "guard)"))
+
+
+def _data_path_dots(jitfn, args, batch: int) -> Tuple[int, int]:
+    """(int8_dots, float_dots) among the DATA-PATH contractions of a
+    jit's PRE-DCE trace: dot_general/conv_general_dilated equations
+    whose output's leading dim is the batch. Weight-side dots (the
+    1x1-merge contraction, fold arithmetic) are weight-shaped and
+    excluded - quantization's claim is about the data path only."""
+    eqns = jitfn.trace(*args).jaxpr.jaxpr.eqns
+    i8 = fp = 0
+    for e in eqns:
+        if e.primitive.name not in ("dot_general",
+                                    "conv_general_dilated"):
+            continue
+        out = e.outvars[0].aval
+        if not out.shape or out.shape[0] != batch:
+            continue
+        dts = {str(v.aval.dtype) for v in e.invars}
+        if dts == {"int8"} and str(out.dtype) == "int32":
+            i8 += 1
+        elif any(d.startswith(("float", "bfloat")) for d in dts):
+            fp += 1
+    return i8, fp
+
+
+_QUANT_PASSES = "dead_layer_elim,fold_conv_bn,quantize_int8"
+
+
+def _quant_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Audit the int8 PTQ path (module docstring): int8 operands +
+    int32 accumulation on every eligible data-path matmul of the
+    quantized trace, zero float data-path dots (vacuity-guarded
+    against the float trace), `layer_quant = float` pin honored, and
+    quantized serving zero-recompile after calibration."""
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.serve import Server
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    def build(extra: str = "", conf: str = _CONF_BN):
+        tr = NetTrainer()
+        for k, v in parse_config_string(conf + extra):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr
+
+    off = build("graph_passes = dead_layer_elim,fold_conv_bn\n")
+    on = build(f"graph_passes = {_QUANT_PASSES}\n")
+    pin = build(f"graph_passes = {_QUANT_PASSES}\n",
+                conf=_CONF_BN.replace(
+                    "nhidden = 3",
+                    "nhidden = 3\n  layer_quant = float"))
+    cal = _batch(0)
+    for tr in (off, on, pin):
+        tr.calibrate_graph_passes(cal)
+    final = on.net_cfg.num_nodes - 1
+    data = np.zeros((32, 1, 1, 36), np.float32)
+
+    def dots(tr):
+        g, ge = tr.stage_infer_rows(data)
+        return _data_path_dots(tr._infer_fn(final),
+                               (tr.state["params"], g, ge), 32)
+
+    i8_on, fp_on = dots(on)
+    i8_off, fp_off = dots(off)
+    checks.append(_check(
+        "quant", "int8-data-path-engaged",
+        i8_on == 2 and fp_on == 0,
+        f"quantized trace: {i8_on} int8/int32 data-path dots, "
+        f"{fp_on} float (want 2 and 0 - both fullc layers must "
+        "route through ops/int8.py)"))
+    checks.append(_check(
+        "quant", "float-trace-vacuity-guard",
+        i8_off == 0 and fp_off == 2,
+        f"float (fold-only) trace: {i8_off} int8 / {fp_off} float "
+        "data-path dots (want 0 and 2, or the engagement check "
+        "proves nothing)"))
+    i8_pin, fp_pin = dots(pin)
+    checks.append(_check(
+        "quant", "layer_quant-float-pin-honored",
+        i8_pin == 1 and fp_pin == 1,
+        f"pinned trace: {i8_pin} int8 / {fp_pin} float data-path "
+        "dots (want 1 each - fc2's explicit float pin must survive "
+        "while fc1 quantizes)"))
+
+    # quantized serving: calibrate BEFORE the Server pins its
+    # executable, then the warmed bucket set must stay flat over a
+    # mixed-size storm (the serve-audit contract on the int8 path)
+    sizes: Dict[str, int] = {}
+    srv = Server(on, max_batch=8, max_wait_ms=1.0, replicas=2)
+    if _cache_size(srv._fn) is None:
+        checks.append(_check(
+            "quant/serve", "cache-size-api", False,
+            "jit._cache_size unavailable on this jax version"))
+        return sizes
+    srv.warmup()
+    n_warm = _cache_size(srv._fn)
+    b8, ge8 = on.stage_infer_rows(np.zeros((8, 1, 1, 36), np.float32))
+    i8_srv, fp_srv = _data_path_dots(
+        srv._fn, (on.state["params"], b8, ge8), 8)
+    checks.append(_check(
+        "quant/serve", "bucket-executables-int8-engaged",
+        i8_srv == 2 and fp_srv == 0
+        and _cache_size(srv._fn) == n_warm,
+        f"bucket-8 trace: {i8_srv} int8 / {fp_srv} float data-path "
+        "dots (tracing must not add executables either)"))
+    srv.start()
+    rng = np.random.RandomState(11)
+    futs = [srv.submit(rng.rand(1 + int(rng.randint(8)), 1, 1, 36)
+                       .astype(np.float32))
+            for _ in range(30)]
+    for f in futs:
+        f.result(timeout=120)
+    stats = srv.stop()
+    n_after = _cache_size(srv._fn)
+    checks.append(_check(
+        "quant/serve", "zero-recompile-after-calibration",
+        n_warm == len(srv.buckets) and n_after == n_warm
+        and stats["errors"] == 0,
+        f"cache {n_warm} -> {n_after} over {stats['batches']} "
+        f"batches (buckets={list(srv.buckets)}, "
+        f"errors={stats['errors']})"))
+    sizes["quant_serve_warm"] = n_warm
+    sizes["quant_serve_after"] = n_after
+    return sizes
+
 
 def _recompile_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
     tr = _make_trainer()
@@ -807,6 +964,7 @@ def run_audit() -> Dict[str, Any]:
     cache_sizes = _recompile_audit(checks)
     cache_sizes.update(_serve_audit(checks))
     cache_sizes.update(_pass_audit(checks))
+    cache_sizes.update(_quant_audit(checks))
     return {
         "platform": jax.default_backend(),
         "jax_version": jax.__version__,

@@ -60,6 +60,11 @@ class LayerParam:
         # ("" = follow the policy). Stored here so the config schema
         # registry harvests the key.
         self.layer_dtype = ""
+        # per-layer quantization pin consumed by the quantize_int8
+        # graph pass (nnet/passes.py): "float" excludes the layer,
+        # "int8" documents the default policy choice, "" follows the
+        # policy. Stored here so the schema registry harvests the key.
+        self.layer_quant = ""
 
     def set_param(self, name: str, val: str) -> None:
         if name == "init_sigma":
@@ -109,6 +114,11 @@ class LayerParam:
                     f"layer_dtype must be float32 or bfloat16, "
                     f"got {val!r}")
             self.layer_dtype = val
+        if name == "layer_quant":
+            if val not in ("", "int8", "float"):
+                raise ValueError(
+                    f"layer_quant must be int8 or float, got {val!r}")
+            self.layer_quant = val
 
     def rand_init_weight(self, key: jax.Array, shape: Sequence[int],
                          in_num: int, out_num: int) -> jax.Array:

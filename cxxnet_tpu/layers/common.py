@@ -85,6 +85,7 @@ class FullConnectLayer(Layer):
         super().__init__(name)
         self.fullc_gather = 0
         self.fused_act = ""
+        self.flatten_input = 0
 
     def set_param(self, name: str, val: str) -> None:
         super().set_param(name, val)
@@ -98,19 +99,25 @@ class FullConnectLayer(Layer):
                 raise ValueError(
                     f"fused_act must be '' or relu, got {val!r}")
             self.fused_act = val
+        if name == "flatten_input":
+            # stamped by the elim_reshape graph pass (nnet/passes.py):
+            # accept a 4-D input node and consume it flattened - the
+            # apply reshapes to (b, -1) anyway, so the eliminated
+            # flatten layer's semantics move in here bitwise
+            self.flatten_input = int(val)
 
     def infer_shapes(self, in_shapes: List[Shape]) -> List[Shape]:
         self.check_one_to_one(in_shapes)
         (b, c, h, w) = in_shapes[0]
-        if not is_mat(in_shapes[0]):
+        if not is_mat(in_shapes[0]) and not self.flatten_input:
             raise ValueError("FullcLayer: input needs to be a matrix")
         if self.param.num_hidden <= 0:
             raise ValueError("FullcLayer: must set nhidden correctly")
-        self.param.num_input_node = w
+        self.param.num_input_node = c * h * w
         return [(b, 1, 1, self.param.num_hidden)]
 
     def init_params(self, key: jax.Array, in_shapes: List[Shape]) -> Params:
-        nin = in_shapes[0][3]
+        nin = in_shapes[0][1] * in_shapes[0][2] * in_shapes[0][3]
         nhidden = self.param.num_hidden
         wmat = self.param.rand_init_weight(
             key, (nhidden, nin), in_num=nin, out_num=nhidden)
@@ -131,6 +138,24 @@ class FullConnectLayer(Layer):
         x = inputs[0]
         b = x.shape[0]
         m = x.reshape(b, -1)
+        if "wmat_q" in params:
+            # int8 PTQ path (nnet/passes.py quantize_int8): the
+            # quantize stage of make_param_fn delivered int8 weights
+            # + frozen scales instead of wmat; contraction runs
+            # int8 x int8 -> int32 (ops/int8.py picks the Pallas MXU
+            # kernel or the lax fallback), dequant + bias + fused
+            # activation in f32, output back at the input dtype
+            from cxxnet_tpu.ops import int8 as int8_ops
+            acc = int8_ops.int8_matmul(
+                int8_ops.quantize_act(m, params["ascale"]),
+                params["wmat_q"])
+            out = int8_ops.dequantize(acc, params["ascale"],
+                                      params["wscale"])
+            if "bias" in params:
+                out = out + params["bias"].astype(jnp.float32)[None, :]
+            if self.fused_act == "relu":
+                out = ops.relu(out)
+            return [out.astype(m.dtype).reshape(b, 1, 1, -1)]
         from cxxnet_tpu.parallel.mesh import batch_shardable, \
             get_active_mesh
         mesh = get_active_mesh()
@@ -289,6 +314,25 @@ class ConvolutionLayer(Layer):
 
     def apply(self, params, inputs, *, train, rng=None):
         p = self.param
+        if "wmat_q" in params:
+            # int8 PTQ path (nnet/passes.py quantize_int8): int8
+            # convolution with int32 accumulation, frozen scales,
+            # f32 dequant + bias + fused activation. The s2d rewrite
+            # does not apply here (ops/int8.py docstring).
+            from cxxnet_tpu.ops import int8 as int8_ops
+            x = inputs[0]
+            acc = int8_ops.int8_conv2d(
+                int8_ops.quantize_act(x, params["ascale"]),
+                params["wmat_q"], p.stride, p.pad_y, p.pad_x,
+                p.num_group)
+            out = int8_ops.dequantize(acc, params["ascale"],
+                                      params["wscale"])
+            if "bias" in params:
+                out = out + params["bias"].astype(
+                    jnp.float32)[None, :, None, None]
+            if self.fused_act == "relu":
+                out = ops.relu(out)
+            return [out.astype(x.dtype)]
         out = ops.conv2d(inputs[0], params["wmat"], p.stride, p.pad_y,
                          p.pad_x, p.num_group, s2d=self.s2d)
         if "bias" in params:

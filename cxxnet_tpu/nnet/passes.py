@@ -77,6 +77,30 @@ engine (docs/GRAPH_PASSES.md). Shipped passes:
   jaxpr loses the separate per-layer elementwise equations (a
   standalone bias layer costs a broadcast + a data-sized add; the
   absorbed form is one vector add inside the param function).
+- **elim_reshape** (infer stage): a `flatten` layer whose output
+  feeds exactly one fullc is eliminated - the fullc consumes the
+  4-D node directly (its apply flattens anyway; the pass stamps
+  `flatten_input = 1` so shape inference accepts it). Bitwise
+  value-identical (same memory-order flatten), one reshape
+  equation fewer in the traced program per site.
+- **quantize_int8** (infer stage): int8 post-training quantization
+  (TVM/Relay's quantize pass shape - arXiv:1810.00952) of eligible
+  conv/fullc layers. A calibration sweep (the fold's
+  pass_calibration machinery) records each eligible layer's
+  activation absmax; the pass then stamps a per-TENSOR activation
+  scale (absmax / 127) per site, and the trainer freezes a
+  per-CHANNEL symmetric weight scale from the TRANSFORMED float
+  weights (post fold/merge/fuse - `_fill_quant_scales`). Execution:
+  `make_param_fn` gains a quantize stage computing the int8 weights
+  IN-JIT from the live params (one fused round/clip/convert pass -
+  the scales are the only frozen constants, invalidated by the same
+  epoch-bump eviction as fold stats on set_weight/reload), and the
+  conv/fullc apply routes through ops/int8.py (Pallas TPU dot
+  kernel with int32 accumulation; lax preferred-element-type
+  fallback on CPU). `layer_quant = int8|float` pins a layer;
+  BN/LRN/loss heads are never eligible (not conv/fullc). See
+  docs/GRAPH_PASSES.md "Quantization" for the scale scheme and
+  "when int8 loses".
 
 Passes never touch the training graph structure or the checkpoint
 format: graph-stage passes only stamp layer configs / dtype
@@ -114,6 +138,17 @@ _FOLDABLE_TYPES = frozenset(("conv", "fullc"))
 _ACT_PRODUCER_TYPES = frozenset(("conv", "fullc"))
 _ACT_CHAIN_TYPES = frozenset(("bias", "relu"))
 _ACT_TYPES = frozenset(("relu",))
+
+# quantize_int8 pattern: the layer types whose data-path contraction
+# has an int8 kernel (ops/int8.py); everything else - BN, LRN, the
+# loss heads - stays float by construction
+_QUANT_TYPES = frozenset(("conv", "fullc"))
+
+# elim_reshape pattern: reshape-only layers, and the consumers that
+# can absorb the flatten (fullc's apply flattens its input anyway -
+# the `flatten_input = 1` stamp makes its shape inference agree)
+_RESHAPE_TYPES = frozenset(("flatten",))
+_RESHAPE_CONSUMER_TYPES = frozenset(("fullc",))
 
 
 # ---------------------------------------------------------------------------
@@ -153,6 +188,22 @@ class ActFuseSite:
 
 
 @dataclass
+class QuantSite:
+    """One int8-quantized conv/fullc: the live-params key, the frozen
+    per-tensor activation scale (calibration absmax / 127), and the
+    frozen per-channel weight scale. `wscale` is filled by the
+    TRAINER after the pipeline runs (`_fill_quant_scales`) from the
+    TRANSFORMED float weights - a folded or merged weight is
+    quantized at its folded/merged values, not its raw checkpoint
+    values; a site whose wscale was never filled executes float
+    (make_param_fn skips its quantize stage)."""
+
+    key: str
+    act_scale: float
+    wscale: Optional[np.ndarray] = None
+
+
+@dataclass
 class GraphModule:
     """A NetConfig DAG in flight through the pass pipeline.
 
@@ -169,6 +220,7 @@ class GraphModule:
     folds: List[FoldSite] = field(default_factory=list)
     merges: List[MergeSite] = field(default_factory=list)
     act_fuses: List[ActFuseSite] = field(default_factory=list)
+    quants: List[QuantSite] = field(default_factory=list)
     dtype_plan: Dict[int, Any] = field(default_factory=dict)
     log: List[str] = field(default_factory=list)
 
@@ -240,6 +292,9 @@ class PassContext:
     #: bn live-params key -> (mean, rstd) calibration stats; None =
     #: not calibrated yet (fold defers)
     fold_stats: Optional[Dict[str, Tuple[np.ndarray, np.ndarray]]] = None
+    #: quant-eligible live-params key -> activation absmax from the
+    #: calibration sweep; None = not calibrated yet (quantize defers)
+    quant_stats: Optional[Dict[str, float]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -297,6 +352,38 @@ def find_fold_sites(cfg: NetConfig) -> List[Tuple[int, int]]:
             continue
         sites.append((i, j))
     return sites
+
+
+def layer_quant_pin(cfg: NetConfig, idx: int) -> str:
+    """The effective `layer_quant` config of layer `idx` ("" = no
+    pin, policy applies). Shared layers resolve through their
+    primary's config like every other structured param."""
+    src = (cfg.layers[idx].primary_layer_index
+           if cfg.layers[idx].is_shared else idx)
+    pin = ""
+    for k, v in cfg.defcfg + cfg.layercfg[src]:
+        if k == "layer_quant":
+            pin = v
+    return pin
+
+
+def find_quant_sites(cfg: NetConfig) -> List[int]:
+    """Layer indices matching the quantize_int8 pattern: non-shared,
+    non-primary conv/fullc layers not pinned `layer_quant = float`.
+    The ONE definition - the pass matches the transformed graph with
+    it and the trainer matches the live graph for calibration taps,
+    so the two can never disagree on what needs an activation
+    range."""
+    primaries = share_primaries(cfg)
+    out: List[int] = []
+    for idx, info in enumerate(cfg.layers):
+        if (info.type_name not in _QUANT_TYPES or info.is_shared
+                or idx in primaries):
+            continue
+        if layer_quant_pin(cfg, idx) == "float":
+            continue
+        out.append(idx)
+    return out
 
 
 def node_writers(cfg: NetConfig, node: int) -> List[int]:
@@ -436,6 +523,15 @@ def find_merge_site(cfg: NetConfig, target: Optional[int],
             continue
         if (dtype_plan or {}).get(i) != (dtype_plan or {}).get(j):
             continue  # differing dtype stamps: a pin must survive
+        if ((layer_quant_pin(cfg, i) == "float")
+                != (layer_quant_pin(cfg, j) == "float")):
+            # the merged conv runs at ONE quantization setting, and
+            # only "float" excludes a site (find_quant_sites) - ""
+            # and an explicit "int8" are the same effective route,
+            # so only a float-vs-quantized mismatch would silently
+            # override a pin (explicit-keys-always-win, the
+            # layer_dtype exclusion rule applied to the quant axis)
+            continue
         if [c for c in cons.get(a, ()) if c != j]:
             continue  # another reader needs the intermediate value
         obj1 = layer_obj(cfg, i)
@@ -466,12 +562,16 @@ class GraphPass:
 PASS_REGISTRY: Dict[str, Type[GraphPass]] = {}
 
 # canonical application order (infer passes prune first so the fold
-# never sees - or folds - a dead subgraph; cse next so dedupe exposes
-# single-consumer fold/merge sites; fuse_activation LAST so chains
-# uncovered by the fold and the 1x1 merge still fuse)
+# never sees - or folds - a dead subgraph; elim_reshape/cse next so
+# cleanup/dedupe exposes single-consumer fold/merge sites;
+# fuse_activation after the structural rewrites so chains uncovered
+# by the fold and the 1x1 merge still fuse; quantize_int8 LAST so it
+# quantizes the final transformed layers - a folded/merged conv is
+# quantized once, at its composed weights)
 _CANONICAL_ORDER = ("space_to_depth", "autocast",
-                    "dead_layer_elim", "cse_share", "fold_conv_bn",
-                    "merge_conv_1x1", "fuse_activation")
+                    "dead_layer_elim", "elim_reshape", "cse_share",
+                    "fold_conv_bn", "merge_conv_1x1",
+                    "fuse_activation", "quantize_int8")
 
 
 def register_pass(cls: Type[GraphPass]) -> Type[GraphPass]:
@@ -829,10 +929,109 @@ class FuseActivationPass(GraphPass):
         return gm
 
 
+@register_pass
+class ElimReshapePass(GraphPass):
+    """Eliminate flatten layers feeding a single fullc (module
+    docstring): the consumer re-reads the flatten's input node and
+    gets a `flatten_input = 1` stamp so its shape inference accepts
+    the 4-D node (its apply flattens in the same memory order, so the
+    rewrite is bitwise value-identical). Runs to a fixpoint."""
+
+    name = "elim_reshape"
+    stage = "infer"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        while True:
+            hit = self._find(gm.cfg, ctx.target_node)
+            if hit is None:
+                return gm
+            i, j = hit
+            cfg = gm.cfg
+            gm.log.append(
+                f"elim_reshape: dropped {cfg.layers[i].type_name}"
+                f"[{i}]; fullc[{j}] consumes node "
+                f"{cfg.layers[i].nindex_in[0]} directly")
+            cfg.layers[j].nindex_in = [cfg.layers[i].nindex_in[0]]
+            cfg.layercfg[j].append(("flatten_input", "1"))
+            gm.remove_layers([i])
+
+    @staticmethod
+    def _find(cfg: NetConfig,
+              target: Optional[int]) -> Optional[Tuple[int, int]]:
+        primaries = share_primaries(cfg)
+        cons = node_consumers(cfg)
+        for i, info in enumerate(cfg.layers):
+            if (info.type_name not in _RESHAPE_TYPES or info.is_shared
+                    or i in primaries or len(info.nindex_in) != 1
+                    or len(info.nindex_out) != 1
+                    or info.nindex_out[0] == info.nindex_in[0]):
+                continue
+            a = info.nindex_out[0]
+            if a == target:
+                continue  # the caller asked for the flat view
+            if node_writers(cfg, a) != [i]:
+                continue  # aliased output node
+            readers = cons.get(a, [])
+            if len(readers) != 1:
+                continue  # a second reader still needs the flat node
+            j = readers[0]
+            cinfo = cfg.layers[j]
+            if (j <= i or cinfo.is_shared or j in primaries
+                    or cinfo.type_name not in _RESHAPE_CONSUMER_TYPES
+                    or len(cinfo.nindex_in) != 1):
+                continue
+            if any(i < w < j
+                   for w in node_writers(cfg, info.nindex_in[0])):
+                # a self-loop between flatten and the fullc rewrites
+                # the input node; the fullc would read the wrong value
+                continue
+            return i, j
+        return None
+
+
+@register_pass
+class QuantizeInt8Pass(GraphPass):
+    """Int8 post-training quantization of eligible conv/fullc layers
+    (module docstring). Defers (logs, no sites) until the calibration
+    sweep recorded activation ranges (`ctx.quant_stats`); the
+    per-channel weight scales are filled by the trainer AFTER the
+    pipeline runs, from the transformed float weights."""
+
+    name = "quantize_int8"
+    stage = "infer"
+
+    def run(self, gm: GraphModule, ctx: PassContext) -> GraphModule:
+        from cxxnet_tpu.ops.int8 import _SCALE_FLOOR
+        sites = find_quant_sites(gm.cfg)
+        if not sites:
+            return gm
+        if ctx.quant_stats is None:
+            gm.log.append(
+                f"quantize_int8: {len(sites)} site(s) deferred - no "
+                "calibration stats yet")
+            return gm
+        for idx in sites:
+            key = gm.param_keys[idx]
+            amax = (ctx.quant_stats.get(key)
+                    if key is not None else None)
+            if amax is None:
+                gm.log.append(
+                    f"quantize_int8: no activation stats for {key}, "
+                    "site stays float")
+                continue
+            gm.quants.append(QuantSite(
+                key=key,
+                act_scale=float(max(amax, _SCALE_FLOOR)) / 127.0))
+            gm.log.append(
+                f"quantize_int8: {key} -> int8 (activation absmax "
+                f"{float(amax):.4g})")
+        return gm
+
+
 # ---------------------------------------------------------------------------
 # params of a transformed graph, from the live train params
 # ---------------------------------------------------------------------------
-def make_param_fn(gm: GraphModule):
+def make_param_fn(gm: GraphModule, quantize: bool = True):
     """jax-traceable function: live train params -> the transformed
     graph's params. Key remaps are free; fold sites compute
     `W' = W * (slope * rstd)` and `b' = (b - mean) * k + beta` from
@@ -844,7 +1043,13 @@ def make_param_fn(gm: GraphModule):
     separate bias-layer params (`b' = b + sum(b_i)`) - applied in
     stages AFTER the folds so a folded conv that later merged (or
     grew a fused activation) composes: each stage reads the previous
-    stage's transform of the same live key."""
+    stage's transform of the same live key. Quant sites run LAST:
+    the int8 weights are one fused round/clip/convert of the staged
+    float weight against the FROZEN per-channel scale (ops/int8.py),
+    so they too stay live functions of the params argument - only
+    the scales are calibration constants. `quantize=False` yields
+    the float view of the same transforms (the trainer evaluates it
+    once to freeze the weight scales)."""
     import jax.numpy as jnp
     pairs = list(gm.param_map().items())
 
@@ -902,6 +1107,26 @@ def make_param_fn(gm: GraphModule):
             if b is not None:
                 p["bias"] = b
             cur[site.producer_key] = p
+        if quantize:
+            from cxxnet_tpu.ops import int8 as int8_ops
+            for site in gm.quants:
+                if site.wscale is None:
+                    continue  # scales never frozen: the site executes
+                    # float (the trainer fills wscale post-pipeline)
+                src = live(site.key)
+                if src is None or "wmat" not in src:
+                    continue
+                entry = {
+                    "wmat_q": int8_ops.quantize_weight(src["wmat"],
+                                                       site.wscale),
+                    "wscale": jnp.asarray(site.wscale, jnp.float32),
+                    "ascale": jnp.asarray(site.act_scale,
+                                          jnp.float32),
+                }
+                b = src.get("bias")
+                if b is not None:
+                    entry["bias"] = b
+                cur[site.key] = entry
 
         out = {}
         for new_key, live_key in pairs:

@@ -82,6 +82,19 @@ class StagedChunk(NamedTuple):
         return len(self.n_examples)
 
 
+def _masked_absmax(x, mask):
+    """Valid-row absmax of a tapped activation (f32) - the
+    quantize_int8 act-scale arithmetic, shared by the single-batch
+    and multi-batch calibration paths so their pinned agreement
+    cannot drift: padding rows carry bias/activation garbage at
+    depth, so the mask keeps them from widening the frozen range."""
+    xf = x.astype(jnp.float32)
+    m = jnp.broadcast_to(
+        mask.astype(jnp.float32).reshape(
+            (-1,) + (1,) * (xf.ndim - 1)), xf.shape)
+    return jnp.max(jnp.abs(xf) * m)
+
+
 def _bf16_cast(data: np.ndarray) -> np.ndarray:
     """f32 -> bf16 on the HOST, fast path via torch (~1.8x faster than
     ml_dtypes on this class of host, bitwise identical round-to-
@@ -196,6 +209,12 @@ class NetTrainer:
         # rstd) frozen at calibration; epoch keys the per-node infer
         # executable cache so a recalibration rebuilds cleanly
         self._fold_stats: Optional[Dict[str, Any]] = None
+        # quantize_int8 calibration state: eligible conv/fullc param
+        # key -> activation absmax from the same calibration sweep
+        # (the per-tensor act scale is absmax/127; the per-channel
+        # weight scales freeze later, per transformed infer graph -
+        # _fill_quant_scales). Shares the fold epoch/eviction.
+        self._quant_stats: Optional[Dict[str, float]] = None
         self._fold_epoch = 0
         self._infer_graph_cache: Dict[Any, Any] = {}
         # TVM-style tuning cache (nnet/tuning.py, tools/autotune.py):
@@ -445,15 +464,20 @@ class NetTrainer:
                                                   self._pass_toggles)
         self._graph_dtype_plan = None
         self._fold_stats = None
+        self._quant_stats = None
         self._fold_epoch = 0
         self._infer_graph_cache = {}
-        # fold sites depend only on the graph structure: matched ONCE
-        # here, not per inference batch (passes_need_calibration sits
-        # on the predict hot path)
-        from cxxnet_tpu.nnet.passes import find_fold_sites
+        # fold/quant sites depend only on the graph structure: matched
+        # ONCE here, not per inference batch (passes_need_calibration
+        # sits on the predict hot path)
+        from cxxnet_tpu.nnet.passes import (
+            find_fold_sites, find_quant_sites)
         self._fold_sites = (find_fold_sites(self.net_cfg)
                             if self._pipeline.has("fold_conv_bn")
                             else [])
+        self._quant_sites = (find_quant_sites(self.net_cfg)
+                             if self._pipeline.has("quantize_int8")
+                             else [])
         if self._pipeline.graph_passes:
             gm = GraphModule.from_net_config(
                 self.net_cfg, self.batch_size, self.compute_dtype)
@@ -522,19 +546,26 @@ class NetTrainer:
                 self.updaters[key][pname] = create_updater(utype, up,
                                                            **kwargs)
 
-    def _init_state(self, params) -> None:
-        # params changed: any frozen fold statistics describe the OLD
-        # activations - drop them AND retire the executables compiled
-        # against them (bumping the epoch + evicting, same as a
-        # recalibration), so an infer_rows/Server built after a
-        # copy_model_from can never silently dispatch a folded
-        # executable frozen with the previous model's statistics.
-        # (Folded weights themselves are live functions of the params
-        # argument; only the stats constants go stale.)
-        if self._fold_stats is not None:
+    def _retire_calibration_state(self) -> None:
+        """Weights changed (set_weight / copy_model_from / checkpoint
+        reload): any frozen fold statistics or quant scales describe
+        the OLD activations/weight ranges - drop them AND retire the
+        executables compiled against them (bumping the epoch +
+        evicting, same as a recalibration), so an infer_rows/Server
+        built afterwards can never silently dispatch an executable
+        frozen with the previous model's constants. Folded weights
+        and the int8 values themselves are live functions of the
+        params argument; only the baked mean/rstd and act/weight
+        scales go stale - the next inference recalibrates them."""
+        if (self._fold_stats is not None
+                or self._quant_stats is not None):
             self._fold_stats = None
+            self._quant_stats = None
             self._fold_epoch += 1
             self._evict_stale_infer_caches()
+
+    def _init_state(self, params) -> None:
+        self._retire_calibration_state()
         ustate = {
             lk: {pn: up.init_state(params[lk][pn])
                  for pn, up in d.items() if pn in params.get(lk, {})}
@@ -672,7 +703,8 @@ class NetTrainer:
         accumulate or masquerade as explicit keys."""
         applied: Dict[str, Any] = {}
         valid = {"space_to_depth": ("0", "1", "auto"),
-                 "layer_dtype": ("float32", "bfloat16")}
+                 "layer_dtype": ("float32", "bfloat16"),
+                 "layer_quant": ("int8", "float")}
         for lname, kv in plan.items():
             idx = self.net_cfg.layer_name_map.get(lname)
             if idx is None or not isinstance(kv, dict):
@@ -684,6 +716,9 @@ class NetTrainer:
                     continue
                 if k == "space_to_depth" and info.type_name != "conv":
                     continue
+                if (k == "layer_quant"
+                        and info.type_name not in ("conv", "fullc")):
+                    continue  # only layers with an int8 kernel route
                 if any(kk == k for kk, _ in
                        (self.net_cfg.defcfg
                         + self.net_cfg.layercfg[idx])):
@@ -1693,7 +1728,10 @@ class NetTrainer:
             # single-batch predict is contraction-ULP-identical to
             # the unfolded path (data-sharded meshes: per-shard vs
             # global stats, warned at calibration)
-            self._calibrate_staged(gdata, gextras)
+            self._calibrate_staged(
+                gdata, gextras,
+                distributed.put_global(np.asarray(mask, np.float32),
+                                       shd))
         out = self._infer_fn(node)(self.state["params"], gdata, gextras)
         valid = int(mask.sum())
         return distributed.fetch_local(out)[:valid]
@@ -1747,22 +1785,59 @@ class NetTrainer:
         gm.dtype_plan = dict(self._graph_dtype_plan or {})
         gm = self._pipeline.run_infer(
             gm, PassContext(target_node=node,
-                            fold_stats=self._fold_stats))
+                            fold_stats=self._fold_stats,
+                            quant_stats=self._quant_stats))
+        self._fill_quant_scales(gm)
         net2 = Network(gm.cfg, self.batch_size)
         net2.dtype_plan = gm.dtype_plan or None
         out = (net2, make_param_fn(gm), gm)
         self._infer_graph_cache[key] = out
         return out
 
+    def _fill_quant_scales(self, gm) -> None:
+        """Freeze each QuantSite's per-channel weight scale from the
+        TRANSFORMED float weights (nnet/passes.py QuantSite): evaluate
+        the float view of the staged param transforms once (eager -
+        a few weight-sized ops) and absmax per output channel on the
+        host, so a folded or merged weight is scaled at its COMPOSED
+        values. The scale is the frozen constant make_param_fn's in-jit
+        quantize stage divides by; the int8 values themselves stay live
+        functions of the params argument."""
+        sites = [s for s in gm.quants if s.wscale is None]
+        if not sites:
+            return
+        from cxxnet_tpu.nnet.passes import make_param_fn
+        from cxxnet_tpu.ops.int8 import per_channel_scale
+        fl = make_param_fn(gm, quantize=False)(self.state["params"])
+        by_live = {live: new for new, live in gm.param_map().items()}
+        for site in sites:
+            entry = fl.get(by_live.get(site.key))
+            if entry is None or "wmat" not in entry:
+                continue  # pruned between matching and build: float
+            # fetch_local, not device_get: params may be sharded
+            # across processes (zero_stage=3 / tensor parallelism),
+            # like every other host read-back in this file
+            site.wscale = per_channel_scale(np.asarray(
+                distributed.fetch_local(entry["wmat"]), np.float32))
+
+    def _needs_fold_stats(self) -> bool:
+        return (self._fold_stats is None
+                and bool(getattr(self, "_fold_sites", ())))
+
+    def _needs_quant_stats(self) -> bool:
+        return (self._quant_stats is None
+                and bool(getattr(self, "_quant_sites", ())))
+
     def passes_need_calibration(self) -> bool:
-        """True when fold_conv_bn is configured, the graph carries at
-        least one fold site, and no calibration stats exist yet - the
+        """True when a calibrating pass (fold_conv_bn's frozen moments,
+        quantize_int8's activation ranges) is configured with at least
+        one matched site whose statistics are missing - the
         predict/extract paths then calibrate on their first batch;
-        serving without calibration runs the unfolded graph (the
+        serving without calibration runs the un-rewritten graph (the
         Server warns - docs/GRAPH_PASSES.md)."""
-        if self._pipeline is None or self._fold_stats is not None:
+        if self._pipeline is None:
             return False
-        return bool(getattr(self, "_fold_sites", ()))
+        return self._needs_fold_stats() or self._needs_quant_stats()
 
     def calibrate_graph_passes(self, batch) -> bool:
         """Capture the fold_conv_bn statistics from one calibration
@@ -1785,12 +1860,14 @@ class NetTrainer:
             return self._calibrate_batches(list(batch))
         if not self.passes_need_calibration():
             return False
-        data, _, _mask, extras = self._pad_batch(batch)
+        data, _, mask, extras = self._pad_batch(batch)
         gdata = self._put_data(data)
         shd = self._batch_sharded
         gextras = tuple(distributed.put_global(e, shd)
                         for e in extras)
-        return self._calibrate_staged(gdata, gextras)
+        return self._calibrate_staged(
+            gdata, gextras,
+            distributed.put_global(np.asarray(mask, np.float32), shd))
 
     def _calibrate_batches(self, batches: List) -> bool:
         """Multi-batch fold calibration: ONE jitted moments forward
@@ -1807,7 +1884,10 @@ class NetTrainer:
         if not self.passes_need_calibration():
             return False
         from cxxnet_tpu.parallel.mesh import active_mesh
-        if self.mesh.shape.get("data", 1) > 1:
+        sites = self._fold_sites if self._needs_fold_stats() else []
+        qsites = (self._quant_sites if self._needs_quant_stats()
+                  else [])
+        if sites and self.mesh.shape.get("data", 1) > 1:
             # same documented caveat as _calibrate_staged: global
             # frozen stats vs the unfolded BN's per-shard stats
             telemetry.stderr(
@@ -1818,7 +1898,6 @@ class NetTrainer:
                 "(docs/GRAPH_PASSES.md)\n",
                 event_kind="graph_passes", op="calibrate_sharded",
                 data_axis=self.mesh.shape.get("data", 1))
-        sites = self._fold_sites
         net = self.net
         daug = self._augment_fn
         eps_by_key = {param_key(self.net_cfg, j):
@@ -1832,6 +1911,7 @@ class NetTrainer:
             for i, e in enumerate(extras):
                 inputs[1 + i] = self._cast(e)
             taps: Dict[int, Any] = {j: None for _i, j in sites}
+            taps.update({q: None for q in qsites})
             with active_mesh(self.mesh):
                 net.forward(cparams, inputs, train=False, taps=taps)
             out = {}
@@ -1854,7 +1934,9 @@ class NetTrainer:
                               keepdims=True) / denom
                 out[param_key(self.net_cfg, j)] = (mean.reshape(-1),
                                                    var.reshape(-1))
-            return out
+            qout = {param_key(self.net_cfg, q):
+                    _masked_absmax(taps[q], mask) for q in qsites}
+            return out, qout
 
         jfn = jax.jit(
             moments_fn,
@@ -1865,6 +1947,7 @@ class NetTrainer:
                           self._batch_sharded),
             out_shardings=self._replicated)
         per_batch: List[Dict[str, Any]] = []
+        q_batch: List[Dict[str, float]] = []
         weights: List[float] = []
         for b in batches:
             data, _, mask, extras = self._pad_batch(b)
@@ -1874,16 +1957,20 @@ class NetTrainer:
                             for e in extras)
             gmask = distributed.put_global(
                 np.asarray(mask, np.float32), shd)
-            res = jfn(self.state["params"], gdata, gextras, gmask)
+            res, qres = jfn(self.state["params"], gdata, gextras,
+                            gmask)
             per_batch.append({
                 k: (np.asarray(distributed.fetch_local(m)),
                     np.asarray(distributed.fetch_local(v)))
                 for k, (m, v) in res.items()})
+            q_batch.append({
+                k: float(np.asarray(distributed.fetch_local(v)))
+                for k, v in qres.items()})
             weights.append(float(np.asarray(mask).sum()))
         w = np.asarray(weights, np.float64)
         w = w / w.sum()
         stats: Dict[str, Any] = {}
-        for key in per_batch[0]:
+        for key in (per_batch[0] if per_batch else {}):
             means = np.stack([pb[key][0] for pb in per_batch])
             variances = np.stack([pb[key][1] for pb in per_batch])
             # pooled moments over the union of REAL rows: each batch
@@ -1897,20 +1984,37 @@ class NetTrainer:
                                  + eps_by_key[key])
             stats[key] = (mean.astype(np.float32),
                           rstd.astype(np.float32))
-        self._fold_stats = stats
+        if sites:
+            self._fold_stats = stats
+        if qsites:
+            # ranges pool by MAX across batches - an absmax is an
+            # absmax over the union of rows, no weighting involved
+            self._quant_stats = {
+                k: max(qb[k] for qb in q_batch) for k in q_batch[0]}
         self._fold_epoch += 1
         self._evict_stale_infer_caches()
         telemetry.event("graph_passes", op="calibrate",
-                        sites=sorted(stats), batches=len(batches))
+                        sites=sorted(stats),
+                        quant_sites=sorted(self._quant_stats or {}),
+                        batches=len(batches))
         return True
 
-    def _calibrate_staged(self, gdata, gextras) -> bool:
+    def _calibrate_staged(self, gdata, gextras, gmask) -> bool:
         """Fold calibration on already-staged device rows: ONE jitted
         forward over the UNFOLDED graph computing each fold site's BN
         input moments with BatchNormLayer._normalize's arithmetic
         (f32 stats, same axes, rsqrt(var + eps)) - the frozen
         (mean, rstd) the folded weights are built from. One-time
         executable; steady-state inference never recompiles it.
+
+        `gmask` (staged valid-row mask) guards ONLY the quant absmax:
+        a round_batch=0 iterator zero-fills its tail batch, and the
+        padding rows' garbage activations at depth must not widen the
+        frozen activation range (the `_calibrate_batches` arithmetic).
+        The fold moments deliberately stay UNmasked here - on the
+        pinned single-batch path the calibration batch IS the
+        inference batch, padding included, and the unfolded BN
+        normalizes over all of it.
 
         Sharding caveat (docs/GRAPH_PASSES.md "when folding loses"):
         the stats here are GLOBAL over the calibration batch, while
@@ -1924,10 +2028,12 @@ class NetTrainer:
         if not self.passes_need_calibration():
             return False
         from cxxnet_tpu.parallel.mesh import active_mesh
-        sites = self._fold_sites
+        sites = self._fold_sites if self._needs_fold_stats() else []
+        qsites = (self._quant_sites if self._needs_quant_stats()
+                  else [])
         net = self.net
         daug = self._augment_fn
-        if self.mesh.shape.get("data", 1) > 1:
+        if sites and self.mesh.shape.get("data", 1) > 1:
             telemetry.stderr(
                 "graph_passes: fold_conv_bn calibrating GLOBAL batch "
                 "statistics on a data-sharded mesh; the unfolded BN "
@@ -1937,7 +2043,7 @@ class NetTrainer:
                 event_kind="graph_passes", op="calibrate_sharded",
                 data_axis=self.mesh.shape.get("data", 1))
 
-        def stats_fn(params, data, extras):
+        def stats_fn(params, data, extras, mask):
             cparams = self._cast(params)
             if daug is not None:
                 data = daug(data, jax.random.PRNGKey(0), False)
@@ -1948,8 +2054,11 @@ class NetTrainer:
             # a `layer[+0] = batch_norm` self-loop overwrites its
             # node, so reading values[node] after the forward would
             # capture POST-normalization moments (~(beta, 1/slope))
-            # and fold silently wrong weights
+            # and fold silently wrong weights. Quant sites tap the
+            # same way: each eligible conv/fullc's INPUT activation,
+            # whose absmax becomes the frozen per-tensor act scale.
             taps: Dict[int, Any] = {j: None for _i, j in sites}
+            taps.update({q: None for q in qsites})
             with active_mesh(self.mesh):
                 net.forward(cparams, inputs, train=False, taps=taps)
             out = {}
@@ -1964,24 +2073,33 @@ class NetTrainer:
                 rstd = lax.rsqrt(var + lay.eps)
                 out[param_key(self.net_cfg, j)] = (mean.reshape(-1),
                                                    rstd.reshape(-1))
-            return out
+            qout = {param_key(self.net_cfg, q):
+                    _masked_absmax(taps[q], mask) for q in qsites}
+            return out, qout
 
         jfn = jax.jit(
             stats_fn,
             in_shardings=(self._params_store_shard,
                           self._data_sharded,
                           (self._batch_sharded,)
-                          * self.net_cfg.extra_data_num),
+                          * self.net_cfg.extra_data_num,
+                          self._batch_sharded),
             out_shardings=self._replicated)
-        res = jfn(self.state["params"], gdata, gextras)
-        self._fold_stats = {
-            k: (np.asarray(distributed.fetch_local(m)),
-                np.asarray(distributed.fetch_local(r)))
-            for k, (m, r) in res.items()}
+        res, qres = jfn(self.state["params"], gdata, gextras, gmask)
+        if sites:
+            self._fold_stats = {
+                k: (np.asarray(distributed.fetch_local(m)),
+                    np.asarray(distributed.fetch_local(r)))
+                for k, (m, r) in res.items()}
+        if qsites:
+            self._quant_stats = {
+                k: float(np.asarray(distributed.fetch_local(v)))
+                for k, v in qres.items()}
         self._fold_epoch += 1
         self._evict_stale_infer_caches()
         telemetry.event("graph_passes", op="calibrate",
-                        sites=sorted(self._fold_stats))
+                        sites=sorted(self._fold_stats or {}),
+                        quant_sites=sorted(self._quant_stats or {}))
         return True
 
     def _evict_stale_infer_caches(self) -> None:
@@ -2258,16 +2376,7 @@ class NetTrainer:
         params[lk[0]][lk[1]] = distributed.put_global_full(
             arr, self._params_store_shard[lk[0]][lk[1]])
         self.state["params"] = params
-        # weights changed: frozen fold statistics describe the OLD
-        # activations - retire them + the executables compiled
-        # against them, same invalidation _init_state applies for
-        # copy_model_from/load_model (the next inference
-        # recalibrates; folded W' tracks live weights, but the baked
-        # mean/rstd would not)
-        if self._fold_stats is not None:
-            self._fold_stats = None
-            self._fold_epoch += 1
-            self._evict_stale_infer_caches()
+        self._retire_calibration_state()
 
     def check_weights(self) -> List[str]:
         """test_on_server analog (async_updater-inl.hpp:144-153): verify

@@ -13,7 +13,9 @@ Schema v2 steps the cache up from global knobs to PER-LAYER plans
 (the Relay/TVM per-operator decision, arXiv:1810.00952): each
 platform entry may carry a `layers` map of per-layer knob choices
 (`space_to_depth` per conv, `layer_dtype` feeding the autocast
-pass's dtype plan) and a `serve_ladder` - explicit serving bucket
+pass's dtype plan, `layer_quant` pinning the quantize_int8 pass's
+per-layer int8-vs-float kernel route) and a `serve_ladder` - explicit
+serving bucket
 sizes shaped from the observed request-size histogram instead of the
 fixed power-of-two set (serve/server.py `ladder_from_histogram`).
 v1 caches (global knobs only) load through a one-shot in-memory
@@ -51,8 +53,14 @@ TUNABLE_KEYS = ("steps_per_dispatch", "prefetch_stage",
                 "serve_max_batch", "stage_dtype")
 
 #: every PER-LAYER knob a v2 plan may carry (values are layer-config
-#: stamps applied by the trainer under explicit-keys-win)
-LAYER_TUNABLE_KEYS = ("space_to_depth", "layer_dtype")
+#: stamps applied by the trainer under explicit-keys-win).
+#: `layer_quant` (int8|float, the quantize_int8 pass's per-layer
+#: kernel-route pin - docs/GRAPH_PASSES.md "Quantization") is a
+#: compatible v2 extension: caches without it load unchanged, and a
+#: cache carrying it is rejected by builds that predate the knob via
+#: the unknown-per-layer-knob check below (regenerate with that
+#: build's tools/autotune.py), never silently misapplied
+LAYER_TUNABLE_KEYS = ("space_to_depth", "layer_dtype", "layer_quant")
 
 
 def _check_ladder(path: str, plat: str, ladder) -> None:

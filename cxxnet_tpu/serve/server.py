@@ -253,18 +253,20 @@ class Server:
                         bucket_sizes(self.max_batch, dsize))
         if getattr(trainer, "passes_need_calibration",
                    lambda: False)():
-            # fold_conv_bn without calibration stats: the infer
-            # executable built below is the UNFOLDED graph (safe,
-            # just unoptimized) and stays so for this Server's
-            # lifetime - warmup on zeros must never become the
-            # calibration batch. task=serve calibrates from the
-            # first pred batch before building the Server (main.py);
-            # programmatic users call trainer.calibrate_graph_passes
-            # (or predict once) first.
+            # a calibrating pass (fold_conv_bn / quantize_int8)
+            # without stats: the infer executable built below is the
+            # un-rewritten FLOAT graph (safe, just unoptimized) and
+            # stays so for this Server's lifetime - warmup on zeros
+            # must never become the calibration batch (zero-input
+            # moments and activation ranges would be garbage).
+            # task=serve calibrates from the first pred batch before
+            # building the Server (main.py); programmatic users call
+            # trainer.calibrate_graph_passes (or predict once) first.
             telemetry.stderr(
-                "serve: graph_passes fold_conv_bn has no calibration "
-                "stats; serving the unfolded graph (calibrate before "
-                "Server creation to fold)\n",
+                "serve: graph passes (fold_conv_bn/quantize_int8) "
+                "have no calibration stats; serving the unoptimized "
+                "float graph (calibrate before Server creation to "
+                "fold/quantize)\n",
                 event_kind="serve", op="fold_uncalibrated")
         self._fn = trainer._infer_fn(self.node)
         c, y, x = trainer.net_cfg.input_shape

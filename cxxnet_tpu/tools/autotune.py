@@ -30,7 +30,12 @@ nnet/tuning.py LAYER_TUNABLE_KEYS): a bounded greedy flip of
 (bf16 + autocast workloads, feeding the autocast pass's dtype plan),
 each candidate measured through the REAL cache-pickup path (a temp
 tuning_cache the trainer replays), so a plan that wins the search is
-by construction a plan the product applies.
+by construction a plan the product applies. Workloads running the
+`quantize_int8` pass additionally search `layer_quant` per eligible
+conv/fullc (pin a layer back to float where int8 loses -
+docs/GRAPH_PASSES.md "when int8 loses"), measured through the
+INFERENCE path (calibrate once, then timed predict_dist) since
+quantization never touches training.
 
 The winners persist under `--out` keyed by jax backend platform
 (cpu/gpu/tpu); `main.py` / `wrapper.Net` pick them up via
@@ -233,14 +238,52 @@ def _measure_plan_ips(conf_pairs, extra, plan, batches,
             os.unlink(path)
 
 
+def _measure_infer_plan_ips(conf_pairs, extra, plan, batches,
+                            budget_s: float) -> float:
+    """Inference images/sec of a per-layer plan candidate through the
+    REAL pickup path (the `layer_quant` axis: quantization only
+    touches the infer executables, so its candidates must be priced
+    on predict, not update): temp tuning_cache, fresh trainer,
+    calibrate on the first batch (quant/fold scales freeze there,
+    outside the timed window), then a timed predict_dist loop."""
+    import tempfile
+
+    import jax
+    from cxxnet_tpu.nnet import tuning
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="cxn_tune_")
+    os.close(fd)
+    os.unlink(path)
+    try:
+        tuning.save_entry(path, jax.default_backend(), {},
+                          layers=plan)
+        tr = _make_trainer(conf_pairs,
+                           list(extra) + [("tuning_cache", path)])
+        tr.predict_dist(batches[0])  # compile + calibrate
+        t0 = time.perf_counter()
+        tr.predict_dist(batches[0])
+        per = max(time.perf_counter() - t0, 1e-6)
+        n = int(min(100, max(3, budget_s / per)))
+        t0 = time.perf_counter()
+        for i in range(n):
+            tr.predict_dist(batches[i % len(batches)])
+        dt = max(time.perf_counter() - t0, 1e-9)
+        return n * tr.batch_size / dt
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+
 def per_layer_search(conf_pairs: Sequence[Tuple[str, str]],
                      budget_s: float,
                      extra: Sequence[Tuple[str, str]] = (),
                      max_layers: int = 6) -> Dict:
     """Bounded greedy per-layer knob search (docs/GRAPH_PASSES.md
     "per-layer autotuner"): for each named strided conv flip
-    `space_to_depth` 0/1, and - on bf16 workloads running the
-    autocast pass - flip conv/fullc layers' `layer_dtype` to f32.
+    `space_to_depth` 0/1; on bf16 workloads running the autocast
+    pass flip conv/fullc layers' `layer_dtype` to f32; on workloads
+    running quantize_int8 flip eligible conv/fullc layers'
+    `layer_quant` to float (int8 is the pass default - the search
+    pins back the layers where it loses), priced on the INFER path.
     A flip joins the plan only when it beats the incumbent by > 2%
     (hysteresis: measurement noise must not churn plans). Returns
     {"layers": plan, "grid": per-candidate ips}."""
@@ -250,6 +293,8 @@ def per_layer_search(conf_pairs: Sequence[Tuple[str, str]],
     autocast_on = (base.compute_dtype == jnp.bfloat16
                    and base._pipeline is not None
                    and base._pipeline.has("autocast"))
+    quant_on = (base._pipeline is not None
+                and base._pipeline.has("quantize_int8"))
     for idx, info in enumerate(base.net_cfg.layers):
         if info.is_shared or not info.name:
             continue
@@ -262,6 +307,9 @@ def per_layer_search(conf_pairs: Sequence[Tuple[str, str]],
         if (autocast_on and info.type_name in ("conv", "fullc")
                 and "layer_dtype" not in explicit):
             cands.append((info.name, "layer_dtype", ("float32",)))
+        if (quant_on and info.type_name in ("conv", "fullc")
+                and "layer_quant" not in explicit):
+            cands.append((info.name, "layer_quant", ("float",)))
     cands = cands[:max_layers]
     grid: Dict[str, float] = {}
     if not cands:
@@ -270,20 +318,50 @@ def per_layer_search(conf_pairs: Sequence[Tuple[str, str]],
     n_meas = 1 + sum(len(c[2]) for c in cands)
     per = max(1.0, budget_s / n_meas)
     plan: Dict[str, Dict[str, str]] = {}
+    # two incumbents, one per measurement path: train-path flips
+    # (s2d/dtype) and infer-path flips (quant) are priced against
+    # their own baseline - the two clocks are not comparable
     best = _measure_plan_ips(conf_pairs, extra, {}, batches, per)
     grid["plan_default"] = round(best, 2)
+    best_infer = None
+    if any(key == "layer_quant" for _ln, key, _a in cands):
+        best_infer = _measure_infer_plan_ips(conf_pairs, extra, {},
+                                             batches, per)
+        grid["plan_infer_default"] = round(best_infer, 2)
+    infer_stale = False
     for lname, key, alts in cands:
+        infer_axis = key == "layer_quant"
         for v in alts:
+            if infer_axis and infer_stale:
+                # a train-axis flip (s2d/dtype) joined the shared
+                # plan since the infer incumbent was measured; those
+                # flips change inference speed too, so re-base it or
+                # the quant trial would be priced against the other
+                # axis's infer-side gain. (The reverse never stales:
+                # layer_quant only touches the infer executables.)
+                best_infer = _measure_infer_plan_ips(
+                    conf_pairs, extra, plan, batches, per)
+                grid["plan_infer_rebase"] = round(best_infer, 2)
+                infer_stale = False
             trial = {ln: dict(kv) for ln, kv in plan.items()}
             trial.setdefault(lname, {})[key] = v
-            ips = _measure_plan_ips(conf_pairs, extra, trial,
-                                    batches, per)
+            measure = (_measure_infer_plan_ips if infer_axis
+                       else _measure_plan_ips)
+            ips = measure(conf_pairs, extra, trial, batches, per)
             grid[f"{lname}.{key}={v}"] = round(ips, 2)
-            if ips > best * 1.02:
+            if infer_axis:
+                if ips > best_infer * 1.02:
+                    best_infer = ips
+                    plan = trial
+            elif ips > best * 1.02:
                 best = ips
                 plan = trial
-    return {"layers": plan, "grid": grid,
-            "plan_best_ips": round(best, 2)}
+                infer_stale = True
+    out = {"layers": plan, "grid": grid,
+           "plan_best_ips": round(best, 2)}
+    if best_infer is not None:
+        out["plan_infer_best_ips"] = round(best_infer, 2)
+    return out
 
 
 def search(conf_pairs: Sequence[Tuple[str, str]], budget_s: float,
@@ -321,6 +399,8 @@ def search(conf_pairs: Sequence[Tuple[str, str]], budget_s: float,
         grid.update(pl["grid"])
         if "plan_best_ips" in pl:
             measured["plan_best_ips"] = pl["plan_best_ips"]
+        if "plan_infer_best_ips" in pl:
+            measured["plan_infer_best_ips"] = pl["plan_infer_best_ips"]
     if serve:
         from cxxnet_tpu.serve import ladder_from_histogram
         sbest = (None, -1.0)

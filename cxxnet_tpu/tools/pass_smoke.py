@@ -44,7 +44,22 @@ PR-11 legs (docs/GRAPH_PASSES.md "Pass catalog"):
   `tuning_cache =` - identical output files (plans are
   deterministic pickups, not per-run noise).
 
-Both inference legs run under `--xla_cpu_use_thunk_runtime=false`
+PR-12 leg (docs/GRAPH_PASSES.md "Quantization"):
+
+- **int8 quant leg**: the SAME trained fullc+bn MLP, `task = pred`
+  with `graph_passes = fold_conv_bn,dead_layer_elim,quantize_int8`
+  vs passes off - argmax agreement >= 95/96 rows (int8 is an
+  approximation, so the pinned threshold prices its accuracy cost
+  instead of demanding identity), a calibrate event carrying
+  `quant_sites` on the quant leg's stream, and an in-process
+  int8-engagement proof at the traced-jaxpr level (the
+  GRAPH_PASSES.md key finding - wins are measured on the traced
+  program): every data-path matmul of the quantized infer trace is
+  int8 x int8 -> int32 with ZERO f32 data-path dots, while the float
+  trace keeps f32 dots (vacuity guard). The verdict is written to
+  `quant_report.json`, uploaded with the CI artifacts.
+
+All inference legs run under `--xla_cpu_use_thunk_runtime=false`
 (the fused/zero/serve smokes' scoped pin): folded and unfolded are
 different program shapes, and the thunk runtime's per-shape codegen
 drifts ~1 ULP - backend noise the argmax labels must not inherit.
@@ -100,6 +115,14 @@ silent = 1
 """
 
 _PASSES = "graph_passes=fold_conv_bn,dead_layer_elim"
+
+# int8 quant leg: the fold pipeline + quantize_int8 on top
+_QUANT_PASSES = "graph_passes=fold_conv_bn,dead_layer_elim," \
+                "quantize_int8"
+# pinned argmax-agreement floor: 95 of the 96 pred rows. int8 is an
+# approximation - the threshold prices its accuracy cost instead of
+# demanding identity (docs/GRAPH_PASSES.md "Quantization")
+_QUANT_AGREE_MIN = 95
 
 # activation-fusion leg: same data blocks, fullc -> bias -> relu head
 CONF_ACT = CONF.replace(
@@ -224,6 +247,49 @@ def _program_sizes() -> dict:
         "final_off": sizes(off, final),
         "final_on": sizes(on, final),
     }
+
+
+def _quant_engagement() -> dict:
+    """In-process int8-engagement proof at the traced-jaxpr level
+    (the GRAPH_PASSES.md key finding - wins are measured on the
+    traced program, and the parity check alone could pass vacuously
+    with quantize_int8 silently off): data-path dot dtypes of the
+    quantized vs float infer executables, classified by the audit's
+    own `_data_path_dots` (one definition)."""
+    from cxxnet_tpu.analysis.jaxpr_audit import _data_path_dots
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    net_conf = CONF.split("netconfig=start")[1].split("netconfig=end")[0]
+    base = ("netconfig=start" + net_conf + "netconfig=end\n"
+            "input_shape = 1,1,36\nbatch_size = 32\ndev = cpu\n"
+            "eta = 0.3\nsilent = 1\nseed = 3\n")
+
+    def build(extra=""):
+        tr = NetTrainer()
+        for k, v in parse_config_string(base + extra):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr
+
+    off = build()
+    on = build(_QUANT_PASSES.replace("=", " = ", 1))
+    rng = np.random.RandomState(9)
+    on.calibrate_graph_passes(DataBatch(
+        data=rng.rand(32, 1, 1, 36).astype(np.float32),
+        label=rng.randint(0, 3, (32, 1)).astype(np.float32)))
+    node = off.net_cfg.num_nodes - 1
+
+    def dots(tr):
+        g, ge = tr.stage_infer_rows(np.zeros((32, 1, 1, 36),
+                                             np.float32))
+        return _data_path_dots(tr._infer_fn(node),
+                               (tr.state["params"], g, ge), 32)
+
+    i8_on, fp_on = dots(on)
+    i8_off, fp_off = dots(off)
+    return {"int8_dots_quant": i8_on, "float_dots_quant": fp_on,
+            "int8_dots_float": i8_off, "float_dots_float": fp_off}
 
 
 def merge_leg() -> dict:
@@ -367,6 +433,22 @@ def run_smoke(out_dir: str) -> int:
     # --- 1x1-merge parity leg (pinned in-process child) ------------
     merge = _run_merge_leg()
 
+    # --- int8 quant leg: quantized pred vs float, same trained MLP -
+    q_pred = os.path.join(out_dir, "pred_quant.txt")
+    q_log = os.path.join(out_dir, "quant_events.jsonl")
+    quant_leg = _run_cli(out_dir, "task=pred", *common,
+                         f"pred={q_pred}", _QUANT_PASSES,
+                         f"log_file={q_log}")
+    qn = _lines(q_pred)
+    q_agree = (sum(a == b for a, b in zip(po, qn))
+               if po and qn and len(po) == len(qn) else 0)
+    q_events = ([e for e in read_jsonl(q_log)
+                 if e.get("kind") == "graph_passes"]
+                if os.path.exists(q_log) else [])
+    q_calibrated = any(e.get("op") == "calibrate"
+                       and e.get("quant_sites") for e in q_events)
+    quant = _quant_engagement()
+
     # --- per-layer-plan autotune leg: tiny grid, cache written then
     # replayed - the plan JSON stays in out_dir as the CI artifact
     plan_json = os.path.join(out_dir, "tuning_plan.json")
@@ -433,6 +515,23 @@ def run_smoke(out_dir: str) -> int:
          f"({merge.get('convs_on')} vs {merge.get('convs_off')})",
          merge.get("convs_off", 0) >= 2
          and merge.get("convs_on") == merge.get("convs_off", 0) - 1),
+        ("int8 leg completed", quant_leg.returncode == 0),
+        (f"int8 argmax agreement >= {_QUANT_AGREE_MIN}/96 "
+         f"(got {q_agree}/96)",
+         qn is not None and len(qn) == 96
+         and q_agree >= _QUANT_AGREE_MIN),
+        ("int8 leg: calibrate event carries quant_sites",
+         q_calibrated),
+        ("int8 engaged: quantized trace is all-int8/int32 data-path "
+         f"dots ({quant.get('int8_dots_quant')} int8, "
+         f"{quant.get('float_dots_quant')} float)",
+         quant.get("int8_dots_quant", 0) > 0
+         and quant.get("float_dots_quant", 1) == 0),
+        ("int8 vacuity guard: float trace keeps float data-path dots "
+         f"({quant.get('float_dots_float')} float, "
+         f"{quant.get('int8_dots_float')} int8)",
+         quant.get("float_dots_float", 0) > 0
+         and quant.get("int8_dots_float", 1) == 0),
         ("autotune leg: schema-v2 cache with a per-layer plan field",
          at.returncode == 0 and plan_blob.get("version") == 2
          and "layers" in plan_blob.get("platforms", {}).get("cpu", {})),
@@ -447,7 +546,7 @@ def run_smoke(out_dir: str) -> int:
         ok = ok and bool(passed)
     if not ok:
         for tag, r in ([("train", train), ("train_act", train_a),
-                        ("autotune", at)]
+                        ("autotune", at), ("quant", quant_leg)]
                        + list(legs.items()) + list(act_legs.items())):
             if r.returncode != 0:
                 print(f"--- {tag} stderr tail ---")
@@ -456,6 +555,12 @@ def run_smoke(out_dir: str) -> int:
             print(f"--- merge leg ---\n{merge['error']}")
     with open(os.path.join(out_dir, "pass_sizes.json"), "w") as f:
         json.dump(sizes, f, indent=1, sort_keys=True)
+    # the quant-leg verdict rides the pass-smoke artifact upload
+    with open(os.path.join(out_dir, "quant_report.json"), "w") as f:
+        json.dump({"argmax_agree": q_agree, "rows": 96,
+                   "agree_min": _QUANT_AGREE_MIN,
+                   "calibrate_event": q_calibrated, **quant},
+                  f, indent=1, sort_keys=True)
     print(f"pass_smoke: {'PASS' if ok else 'FAIL'} "
           f"(raw max diff {raw_diff:.2e}; extract traced "
           f"{ex_off['eqns']}->{ex_on['eqns']} eqns)")

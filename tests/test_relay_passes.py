@@ -400,6 +400,38 @@ def test_cse_dedupes_paramless_siblings():
 
 
 # ---------------------------------------------------------------------------
+# elim_reshape
+# ---------------------------------------------------------------------------
+def test_elim_reshape_bitwise_parity_and_fewer_eqns():
+    """The flatten feeding a single fullc is eliminated: bitwise
+    value-identical (the fullc's apply flattens in the same memory
+    order), strictly fewer traced equations at equal contraction
+    count (the pass-audit claim, at the test surface)."""
+    off, on = _train_pair(MERGE_CONF, "dead_layer_elim,elim_reshape",
+                          shape=(3, 8, 8))
+    b = _batch(60, shape=(3, 8, 8))
+    assert (off.predict_dist(b) == on.predict_dist(b)).all()
+    gm = on._build_infer_graph(on.net_cfg.num_nodes - 1)[2]
+    assert any("elim_reshape" in line for line in gm.log)
+    e_off, p_off = _prims(off, (3, 8, 8))
+    e_on, p_on = _prims(on, (3, 8, 8))
+    assert e_on < e_off
+    assert (p_on.get("dot_general", 0)
+            == p_off.get("dot_general", 0))
+    assert (p_on.get("conv_general_dilated", 0)
+            == p_off.get("conv_general_dilated", 0))
+
+
+def test_elim_reshape_kept_when_flat_node_is_target():
+    """extract of the flat node itself must keep the flatten."""
+    tr = _build(MERGE_CONF, "graph_passes = elim_reshape\n")
+    flat_node = tr.net.node_index("fl")
+    gm = tr._build_infer_graph(flat_node)[2]
+    assert not any("elim_reshape" in line for line in gm.log)
+    assert any(li.type_name == "flatten" for li in gm.cfg.layers)
+
+
+# ---------------------------------------------------------------------------
 # pipeline integration
 # ---------------------------------------------------------------------------
 def test_canonical_order_and_all_includes_new_passes():
