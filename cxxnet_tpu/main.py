@@ -103,6 +103,18 @@ class LearnTask:
         self.device = "tpu"
         self.eval_train = 1
         self.test_on_server = 0
+        # elastic pod training (docs/FAULT_TOLERANCE.md "Elastic
+        # pod"): elastic=1 arms the coordinated-checkpoint barrier at
+        # every round boundary - the pod elects a leader over the
+        # coord_dir control plane (default <model_dir>/coord), ONLY
+        # the leader publishes the round's checkpoint, and an absent
+        # member is convicted so the supervisor
+        # (parallel/elastic.py) can roll back + reshape
+        self.elastic = 0
+        self.barrier_secs = 30.0
+        self.leader_lease_secs = 10.0
+        self.coord_dir = ""
+        self._coordinator = None
         # config schema gate (docs/STATIC_ANALYSIS.md): unknown keys
         # error with a did-you-mean suggestion instead of silently
         # configuring nothing; schema_check = 0 bypasses
@@ -226,6 +238,8 @@ class LearnTask:
                 raise ValueError(f"unknown task {self.task}")
             return 0
         finally:
+            if self._coordinator is not None:
+                self._coordinator.close()
             # final snapshot + clean close even on an aborting task, so
             # the stream explains the crash (heartbeat stops with it)
             telemetry.event("run_end", task=self.task,
@@ -274,6 +288,14 @@ class LearnTask:
             self.eval_train = int(val)
         if name == "test_on_server":
             self.test_on_server = int(val)
+        if name == "elastic":
+            self.elastic = int(val)
+        if name == "barrier_secs":
+            self.barrier_secs = float(val)
+        if name == "leader_lease_secs":
+            self.leader_lease_secs = float(val)
+        if name == "coord_dir":
+            self.coord_dir = val
         if name == "extract_node_name":
             self.extract_node_name = val
         if name == "output_format":
@@ -485,6 +507,8 @@ class LearnTask:
         # device view (idempotent; trainer.init_model also calls it)
         from cxxnet_tpu.parallel import distributed
         distributed.init_from_config(self.cfg)
+        if self.elastic and self.task in ("train", "finetune"):
+            self._start_coordinator()
         if self.task == "train" and self.continue_training:
             if self._sync_latest_model():
                 telemetry.stdout(f"Init: Continue training from round "
@@ -507,6 +531,38 @@ class LearnTask:
         else:
             self._load_model()
         self._create_iterators()
+
+    def _start_coordinator(self) -> None:
+        """Arm the elastic coordinator (parallel/coordinator.py):
+        membership comes from the supervisor's generation.json when
+        present (the record names this pod generation's members; this
+        worker's member id arrives in CXN_MEMBER_ID), and degrades to
+        rank-as-member for a pod launched without a supervisor."""
+        import jax
+        from cxxnet_tpu.parallel import distributed
+        from cxxnet_tpu.parallel.coordinator import (ControlPlane,
+                                                     Coordinator)
+        coord_dir = self.coord_dir or os.path.join(
+            self.name_model_dir, "coord")
+        os.makedirs(coord_dir, exist_ok=True)
+        generation, members = 0, list(range(jax.process_count()))
+        if os.path.exists(os.path.join(coord_dir, "generation.json")):
+            rec = distributed.read_membership(coord_dir)
+            generation = int(rec.get("generation", 0))
+            members = [int(m) for m in rec["members"]]
+        member_env = os.environ.get("CXN_MEMBER_ID")
+        if member_env is not None:
+            member = int(member_env)
+        else:
+            member = members[jax.process_index()]
+        plane = ControlPlane(coord_dir)
+        self._coordinator = Coordinator(
+            plane, member, members, generation=generation,
+            barrier_secs=self.barrier_secs,
+            lease_secs=self.leader_lease_secs)
+        self._coordinator.start()
+        telemetry.event("coord", op="start", member=member,
+                        generation=generation, members=members)
 
     def _model_name(self, counter: int) -> str:
         return os.path.join(self.name_model_dir, f"{counter:04d}.model")
@@ -613,7 +669,19 @@ class LearnTask:
         # 0014.model. Kept so round numbering matches the reference.
         counter = self.start_counter
         self.start_counter += 1
+        barrier = None
+        if self._coordinator is not None:
+            # elastic pod: EVERY round boundary is a barrier (absent
+            # members must be convicted promptly, not only on save
+            # rounds), and on save rounds only the elected leader
+            # writes - ending the N-independent-writers race on the
+            # shared %04d.model path
+            barrier = self._pod_barrier(counter)
         if self.save_period == 0 or self.start_counter % self.save_period:
+            return
+        if barrier is not None and not barrier.is_leader:
+            telemetry.event("checkpoint", op="skip_nonleader",
+                            round=counter, leader=barrier.leader)
             return
         os.makedirs(self.name_model_dir, exist_ok=True)
         path = self._model_name(counter)
@@ -636,7 +704,31 @@ class LearnTask:
             nbytes = -1
         telemetry.event("checkpoint", op="save", round=counter,
                         path=path, secs=secs, bytes=nbytes)
+        if barrier is not None:
+            # pod-wide publish manifest: the checkpoint the pod agrees
+            # on, stamped with the monotonically increasing pod epoch
+            # (what a restarted/reshaped generation resumes from)
+            from cxxnet_tpu.parallel.coordinator import file_sha256
+            self._coordinator.publish(barrier, counter, path,
+                                      file_sha256(path), nbytes)
         self._rotate_models(counter)
+
+    def _pod_barrier(self, counter: int):
+        """One coordinated checkpoint barrier; a conviction exits this
+        worker with RESHAPE_EXIT_CODE so the supervisor rolls the pod
+        back to the published checkpoint and rebuilds it around the
+        missing member (docs/FAULT_TOLERANCE.md "Elastic pod")."""
+        from cxxnet_tpu.parallel.coordinator import PodReshapeRequired
+        from cxxnet_tpu.utils.fault import RESHAPE_EXIT_CODE
+        try:
+            return self._coordinator.barrier(counter)
+        except PodReshapeRequired as e:
+            telemetry.stderr(
+                f"elastic: {e}; exiting for pod reshape\n",
+                event_kind="coord", op="reshape_exit", round=counter,
+                missing=e.missing, dead=e.dead)
+            sys.stderr.flush()
+            sys.exit(RESHAPE_EXIT_CODE)
 
     def _rotate_models(self, saved: int) -> None:
         """keep_latest=k: bound the checkpoint set to the k newest

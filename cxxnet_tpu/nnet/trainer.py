@@ -1521,6 +1521,13 @@ class NetTrainer:
             if self.profiler is not None:
                 self.profiler.add_data(data_s)
             t0 = t1
+        # collective-scope fault point (docs/FAULT_TOLERANCE.md
+        # "Elastic pod"): the dispatched step carries the pod-wide
+        # gradient AllReduce, so kill_rank/hang_rank/delay_collective
+        # armed here murder or wedge ONE worker at a deterministic
+        # step - every rank hits this point in the same order under
+        # SPMD, so @N names the same step on every worker
+        fault.fault_point("collective")
         # the step is dispatched asynchronously and train metrics
         # accumulate on device - nothing here blocks on the result, so
         # host-side input prep for batch k+1 overlaps compute of batch k
@@ -1605,6 +1612,9 @@ class NetTrainer:
             if self.profiler is not None:
                 self.profiler.add_data(data_s)
             t0 = t1
+        # same collective-scope fault point as the streamed path: one
+        # hit per DISPATCH (K microsteps), still rank-deterministic
+        fault.fault_point("collective")
         self.state, losses, finites = self._train_chunk(
             self.state, chunk.data, chunk.extras, chunk.labels,
             chunk.mask, step_idx, base_rng)

@@ -24,6 +24,7 @@ on every device/process actually is.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -31,18 +32,67 @@ import numpy as np
 
 import jax
 
+from cxxnet_tpu.utils.config import ConfigError
+from cxxnet_tpu.utils.fault import retry
+
 
 _initialized = False
+
+# bounded init retry defaults (overridable per call / via the
+# dist_init_* config keys): a peer that is still binding its
+# coordinator port, or a control-plane record written a beat late,
+# costs a backoff, not the pod - but the wait is CAPPED, because an
+# address that is simply wrong must become a clear error, not an
+# infinite connect loop
+INIT_ATTEMPTS = 5
+INIT_BACKOFF = 0.5
+INIT_DEADLINE = 120.0
+
+
+def _enable_cpu_collectives() -> None:
+    """Select the gloo TCP collectives for multi-process CPU jobs.
+
+    jax's CPU client is built with NO cross-process collective
+    implementation by default - a multi-controller job on the cpu
+    platform compiles fine and then dies at the first AllReduce with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    The implementation is chosen when the backend client is CREATED,
+    so the flag must be set here (before jax.distributed.initialize;
+    the client does not exist yet or initialize itself would fail).
+    Scoped to cpu platforms: TPU pods keep their native ICI
+    collectives and never see this flag."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if not platforms:
+        try:
+            platforms = jax.config.jax_platforms or ""
+        except AttributeError:  # very old/new jax: leave the default
+            return
+    if "cpu" in str(platforms).lower():
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            # flag renamed/absent on this jax: the job either works
+            # without it or fails with the explicit runtime error
+            pass
 
 
 def init_distributed(coordinator: Optional[str] = None,
                      num_workers: Optional[int] = None,
-                     rank: Optional[int] = None) -> None:
+                     rank: Optional[int] = None,
+                     attempts: int = INIT_ATTEMPTS,
+                     backoff: float = INIT_BACKOFF,
+                     deadline: float = INIT_DEADLINE) -> None:
     """Join the multi-controller job (idempotent).
 
     Arguments fall back to CXN_COORDINATOR / CXN_NUM_WORKER /
     CXN_WORKER_RANK env vars (the launcher sets them). Single-worker
     jobs are a no-op, like the reference's local parameter server.
+
+    The gloo/distributed handshake is retried with exponential backoff
+    + jitter (the PR 1 ``retry`` decorator): a slow-starting peer used
+    to be an immediate crash. Total wait is capped by ``deadline``
+    seconds; exhaustion raises ``ConfigError`` naming the coordinator.
     """
     global _initialized
     if _initialized:
@@ -58,9 +108,28 @@ def init_distributed(coordinator: Optional[str] = None,
         raise ValueError(
             "param_server=dist needs dist_coordinator (or "
             "CXN_COORDINATOR) when dist_num_worker > 1")
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_workers,
-                               process_id=rank)
+    _enable_cpu_collectives()
+
+    # RuntimeError is what jax.distributed surfaces for a refused /
+    # unreachable coordinator; OSError covers raw socket failures.
+    # ValueError (bad arguments) propagates immediately - retrying a
+    # typo'd rank cannot help.
+    @retry(attempts=max(1, attempts), backoff=backoff,
+           jitter=backoff / 2, retry_on=(RuntimeError, OSError),
+           deadline=deadline)
+    def _connect():
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_workers,
+                                   process_id=rank)
+
+    try:
+        _connect()
+    except (RuntimeError, OSError) as e:
+        raise ConfigError(
+            f"param_server=dist: could not join the job at "
+            f"{coordinator} as rank {rank}/{num_workers} after "
+            f"{attempts} attempts (deadline {deadline:g}s): {e}"
+        ) from e
     _initialized = True
 
 
@@ -76,7 +145,45 @@ def init_from_config(pairs: List[Tuple[str, str]]) -> None:
         num_workers=int(cfg["dist_num_worker"])
         if "dist_num_worker" in cfg else None,
         rank=int(cfg["dist_worker_rank"])
-        if "dist_worker_rank" in cfg else None)
+        if "dist_worker_rank" in cfg else None,
+        attempts=int(cfg.get("dist_init_retries", INIT_ATTEMPTS)),
+        backoff=float(cfg.get("dist_init_backoff", INIT_BACKOFF)),
+        deadline=float(cfg.get("dist_init_deadline", INIT_DEADLINE)))
+
+
+def read_membership(coord_dir: str, attempts: int = INIT_ATTEMPTS,
+                    backoff: float = INIT_BACKOFF,
+                    deadline: float = INIT_DEADLINE) -> Dict[str, Any]:
+    """The pod membership record (``generation.json`` - written by the
+    elastic supervisor before each launch, parallel/coordinator.py),
+    read with the same bounded retry discipline as the gloo init: the
+    record may lag the worker by a beat at generation start, and on a
+    network filesystem a read can transiently fail - but a coord_dir
+    that never produces a record must become a clear ConfigError, not
+    a silent hang or a crash on the first ENOENT."""
+    path = os.path.join(coord_dir, "generation.json")
+
+    @retry(attempts=max(1, attempts), backoff=backoff,
+           jitter=backoff / 2, retry_on=(OSError,), deadline=deadline)
+    def _read() -> Dict[str, Any]:
+        with open(path, "r", encoding="utf-8") as f:
+            try:
+                rec = json.load(f)
+            except ValueError as e:
+                # torn read on close-to-open-consistency filesystems:
+                # transient, retry-absorbable like the OSError path
+                raise OSError(f"unparseable membership record: {e}")
+        if not isinstance(rec, dict) or "members" not in rec:
+            raise OSError(f"membership record missing 'members': {rec}")
+        return rec
+
+    try:
+        return _read()
+    except OSError as e:
+        raise ConfigError(
+            f"elastic: cannot read pod membership record {path} "
+            f"after {attempts} attempts (deadline {deadline:g}s): {e}"
+        ) from e
 
 
 def process_count() -> int:

@@ -23,6 +23,14 @@ every poll renders ONE merged cluster table:
 
 ``--follow`` re-polls every ``--interval`` seconds and reprints;
 ``--json`` emits the merged state as one JSON object for scripting.
+
+``--verdict-json`` is the detection-to-DECISION surface (the elastic
+supervisor's conviction input, parallel/elastic.py): one JSON object
+whose ``restart`` list names every process the aggregation convicts -
+``stale`` (silent past ``--stale-secs``: preempted / wedged /
+partitioned) or ``straggler`` (step p50 past ``--straggler-factor`` x
+the cluster median). Exit status 3 when a restart is recommended, 0
+when the pod is healthy - scriptable both ways.
 """
 
 from __future__ import annotations
@@ -257,6 +265,33 @@ class Aggregator:
                 "source_errors": {s.name: s.errors
                                   for s in self.sources if s.errors}}
 
+    def verdict(self, now: Optional[float] = None) -> Dict:
+        """Machine-readable restart recommendation: the cluster state
+        (to_dict) plus a ``restart`` list - one entry per process the
+        flags convict, with the evidence (record age for STALE, p50
+        ratio vs the cluster median for STRAGGLER). Deterministic in
+        ``now`` so tests pin it with a fake clock."""
+        d = self.to_dict(now)
+        sp = d["spread"]
+        restart = []
+        for key, h in d["hosts"].items():
+            if "STALE" in h["flags"]:
+                restart.append({
+                    "host": key, "reason": "stale",
+                    "age_s": h["age_s"],
+                    "stale_secs": self.stale_secs})
+            elif "STRAGGLER" in h["flags"]:
+                ratio = (h["step_p50_ms"] / sp["median_ms"]
+                         if sp and sp["median_ms"] else None)
+                restart.append({
+                    "host": key, "reason": "straggler",
+                    "step_p50_ms": h["step_p50_ms"],
+                    "median_ms": sp["median_ms"] if sp else None,
+                    "ratio": round(ratio, 2) if ratio else None,
+                    "straggler_factor": self.straggler_factor})
+        d["restart"] = restart
+        return d
+
     # -- rendering ---------------------------------------------------------
     def render(self, now: Optional[float] = None) -> str:
         d = self.to_dict(now)
@@ -299,6 +334,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     follow = "--follow" in argv
     as_json = "--json" in argv
+    as_verdict = "--verdict-json" in argv
     interval = 2.0
     stale = STALE_SECS
     factor = STRAGGLER_FACTOR
@@ -315,7 +351,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif a == "--straggler-factor":
             factor = float(argv[i + 1])
             i += 2
-        elif a in ("--follow", "--json"):
+        elif a in ("--follow", "--json", "--verdict-json"):
             i += 1
         elif a.startswith("--"):
             print(f"agg: unknown flag {a}")
@@ -329,6 +365,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     agg = Aggregator([make_source(p) for p in paths],
                      stale_secs=stale, straggler_factor=factor)
+    if as_verdict:
+        agg.poll()
+        v = agg.verdict()
+        print(json.dumps(v, indent=2, default=str))
+        return 3 if v["restart"] else 0
     try:
         while True:
             agg.poll()

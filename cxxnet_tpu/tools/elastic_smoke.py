@@ -1,0 +1,271 @@
+#!/usr/bin/env python3
+"""elastic_smoke: the elastic-pod end-to-end proof (CI: elastic-smoke).
+
+    env JAX_PLATFORMS=cpu python -m cxxnet_tpu.tools.elastic_smoke --out DIR
+
+Drives the full product surface - the elastic supervisor
+(parallel/elastic.py) over real ``python -m cxxnet_tpu.main`` worker
+processes on the CPU/gloo backend - through a deterministic worker
+murder, and asserts the whole robustness story of
+docs/FAULT_TOLERANCE.md "Elastic pod":
+
+1. a 3-process pod trains with coordinated checkpoint barriers; the
+   ``collective:kill_rank=0@K`` injector kills the LEADER mid-round;
+2. the supervisor reshapes: generation 1 runs with the 2 surviving
+   members, a NEW leader (lowest live member) is elected, and training
+   continues from the published rollback checkpoint to completion;
+3. exactly ONE process published every checkpoint (manifest + event
+   logs + no orphan ``*.tmp`` in the model dir);
+4. the final checkpoint is byte-identical (sha256) to an UNINTERRUPTED
+   2-process run resumed from the same rollback checkpoint, and the
+   per-round eval lines after the rollback match line for line - the
+   reshape cost one rolled-back round, not correctness.
+
+Run in fresh subprocesses by construction (every worker is its own
+process): the long-lived many-jit jax-cpu SIGSEGV pattern and the rare
+device_put segfault flake (PR 1 / PR 6 precedent) never share a
+process with the assertions here.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import hashlib
+import json
+import os
+import re
+import shutil
+import struct
+import sys
+from typing import Dict, List
+
+
+def _write_dataset(dirname: str, n: int = 48) -> Dict[str, str]:
+    """Tiny deterministic MNIST-format dataset (same recipe as the
+    distributed CLI tests)."""
+    import numpy as np
+    rng = np.random.RandomState(7)
+    labels = rng.randint(0, 10, size=n).astype(np.uint8)
+    images = rng.randint(0, 255, size=(n, 12, 12)).astype(np.uint8)
+    os.makedirs(dirname, exist_ok=True)
+    img = os.path.join(dirname, "img.gz")
+    lbl = os.path.join(dirname, "lbl.gz")
+    with gzip.open(img, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, n, 12, 12))
+        f.write(images.tobytes())
+    with gzip.open(lbl, "wb") as f:
+        f.write(struct.pack(">ii", 2049, n))
+        f.write(labels.tobytes())
+    return {"img": img, "lbl": lbl}
+
+
+CONF = """
+data = train
+iter = mnist
+    path_img = "{img}"
+    path_label = "{lbl}"
+    input_flat = 1
+iter = end
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 16
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 10
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,1,144
+random_type = xavier
+batch_size = 24
+eta = 0.1
+momentum = 0.9
+num_round = {rounds}
+max_round = {rounds}
+save_model = 1
+metric = error
+eval_train = 1
+dev = cpu
+silent = 1
+model_dir = {model_dir}
+barrier_secs = 60
+leader_lease_secs = 5
+elastic_nproc = {nproc}
+elastic_respawn = {respawn}
+elastic_stale_secs = 0
+elastic_absence_secs = 0
+{extra}
+"""
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _run_pod(conf_path: str) -> int:
+    from cxxnet_tpu.parallel.elastic import ElasticPod
+    return ElasticPod(conf_path).run()
+
+
+def _events(coord_dir: str) -> List[Dict]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(coord_dir,
+                                              "events.*.jsonl"))):
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
+
+
+def _eval_lines(coord_dir: str) -> Dict[int, str]:
+    """round -> eval stderr line, from the worker logs (any member's
+    copy; every member prints the same line for the same round)."""
+    out: Dict[int, str] = {}
+    for path in sorted(glob.glob(os.path.join(coord_dir,
+                                              "worker.*.log"))):
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                m = re.match(r"^\[(\d+)\]\ttrain-error:", line)
+                if m:
+                    out[int(m.group(1))] = line.rstrip("\n")
+    return out
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    out = "elastic-smoke-out"
+    nproc, rounds, kill_hit = 3, 6, 7
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--out":
+            out = argv[i + 1]
+            i += 2
+        elif argv[i] == "--nproc":
+            nproc = int(argv[i + 1])
+            i += 2
+        elif argv[i] == "--rounds":
+            rounds = int(argv[i + 1])
+            i += 2
+        else:
+            print(__doc__)
+            return 2
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # one CPU device per worker: the pytest parent's virtual-device
+    # XLA_FLAGS must not leak into the pod
+    os.environ["XLA_FLAGS"] = ""
+    os.makedirs(out, exist_ok=True)
+    data = _write_dataset(os.path.join(out, "data"))
+
+    # ---- run A: the interrupted pod ------------------------------------
+    # 2 dispatches per round (48 samples / batch 24); member 0 (the
+    # generation-0 leader) dies at collective hit `kill_hit` - mid
+    # round ceil(kill_hit/2), after rounds 1..ceil-1 published
+    dir_a = os.path.join(out, "run_a")
+    conf_a = os.path.join(out, "a.conf")
+    with open(conf_a, "w") as f:
+        f.write(CONF.format(
+            img=data["img"], lbl=data["lbl"], rounds=rounds,
+            model_dir=dir_a, nproc=nproc, respawn=0,
+            extra=('elastic_fault = '
+                   f'"collective:kill_rank=0@{kill_hit}"')))
+    print(f"elastic-smoke: run A ({nproc}-process pod, leader killed "
+          f"at collective hit {kill_hit})")
+    rc = _run_pod(conf_a)
+    assert rc == 0, f"interrupted pod did not recover: rc={rc}"
+
+    coord_a = os.path.join(dir_a, "coord")
+    events = _events(coord_a)
+    gens = {e["generation"]: e for e in events
+            if e["kind"] == "generation_start"}
+    assert len(gens) >= 2, f"no reshape happened: {sorted(gens)}"
+    g0, g1 = gens[0]["members"], gens[1]["members"]
+    assert len(g1) == nproc - 1 and 0 not in g1, \
+        f"expected N-1 reshape without member 0: g0={g0} g1={g1}"
+    print(f"elastic-smoke: reshape ok: generation 0 {g0} -> "
+          f"generation 1 {g1}")
+
+    # leader re-election: generation-0 barriers led by member 0,
+    # generation-1 barriers led by the lowest survivor
+    leaders = {(e["generation"], e["leader"]) for e in events
+               if e["kind"] == "barrier"}
+    assert (0, 0) in leaders, f"gen-0 leader was not member 0: {leaders}"
+    assert (1, min(g1)) in leaders, \
+        f"gen-1 leader was not re-elected to {min(g1)}: {leaders}"
+    print(f"elastic-smoke: leader re-election ok: 0 -> {min(g1)}")
+
+    # single-publisher: exactly one publish event per round, and the
+    # checkpoint dir holds no orphan tmp files
+    pubs: Dict[int, List[Dict]] = {}
+    for e in events:
+        if e["kind"] == "publish":
+            pubs.setdefault(e["round"], []).append(e)
+    for rnd, recs in sorted(pubs.items()):
+        assert len(recs) == 1, \
+            f"round {rnd} published by {len(recs)} writers: {recs}"
+    assert not glob.glob(os.path.join(dir_a, "*.tmp")), \
+        "orphan .tmp files in the checkpoint dir"
+    for rnd in range(rounds + 1):
+        assert rnd in pubs, f"round {rnd} never published: {sorted(pubs)}"
+    # the generation-0 publishes stop at the rollback point
+    g0_pubs = [r for r, recs in pubs.items()
+               if recs[0]["who"] == "m0"]
+    rollback = max(g0_pubs)
+    assert rollback < rounds, "the kill round was published?!"
+    print(f"elastic-smoke: single-publisher ok "
+          f"({len(pubs)} rounds); rollback point = round {rollback}")
+
+    # ---- run B: uninterrupted N-1 run from the rollback point ----------
+    dir_b = os.path.join(out, "run_b")
+    os.makedirs(dir_b, exist_ok=True)
+    shutil.copy(os.path.join(dir_a, f"{rollback:04d}.model"),
+                os.path.join(dir_b, f"{rollback:04d}.model"))
+    conf_b = os.path.join(out, "b.conf")
+    with open(conf_b, "w") as f:
+        f.write(CONF.format(
+            img=data["img"], lbl=data["lbl"], rounds=rounds,
+            model_dir=dir_b, nproc=nproc - 1, respawn=0, extra=""))
+    print(f"elastic-smoke: run B (uninterrupted {nproc - 1}-process "
+          f"pod from round {rollback})")
+    rc = _run_pod(conf_b)
+    assert rc == 0, f"reference pod failed: rc={rc}"
+
+    # ---- the equivalence proof -----------------------------------------
+    final_a = os.path.join(dir_a, f"{rounds:04d}.model")
+    final_b = os.path.join(dir_b, f"{rounds:04d}.model")
+    sha_a, sha_b = _sha256(final_a), _sha256(final_b)
+    assert sha_a == sha_b, (
+        f"final checkpoints diverge: interrupted {sha_a} vs "
+        f"uninterrupted {sha_b}")
+    ev_a = _eval_lines(coord_a)
+    ev_b = _eval_lines(os.path.join(dir_b, "coord"))
+    for rnd in range(rollback + 1, rounds + 1):
+        assert rnd in ev_a and rnd in ev_b, \
+            f"missing eval line for round {rnd}"
+        assert ev_a[rnd] == ev_b[rnd], (
+            f"loss trajectory diverges at round {rnd}: "
+            f"{ev_a[rnd]!r} vs {ev_b[rnd]!r}")
+    print(f"elastic-smoke: final checkpoint sha256 identical "
+          f"({sha_a[:16]}...), eval lines for rounds "
+          f"{rollback + 1}..{rounds} match")
+
+    summary = {
+        "nproc": nproc, "rounds": rounds, "kill_hit": kill_hit,
+        "generations": {str(g): gens[g]["members"] for g in gens},
+        "rollback_round": rollback, "final_sha256": sha_a,
+        "manifest": json.load(open(os.path.join(coord_a,
+                                                "published.json"))),
+    }
+    with open(os.path.join(out, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print("elastic-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,6 +118,38 @@ def retry(attempts: int = 3, backoff: float = 0.05, jitter: float = 0.05,
 # ---------------------------------------------------------------------------
 FAULT_ENV = "CXXNET_FAULT"
 KILL_EXIT_CODE = 117  # distinctive: assertable from subprocess tests
+# a worker that convicts an absent peer at a checkpoint barrier exits
+# with this code so the elastic supervisor (parallel/elastic.py) knows
+# to reshape the pod rather than treat it as a crash
+RESHAPE_EXIT_CODE = 118
+
+
+def current_rank() -> int:
+    """This process's identity for the rank-scoped fault modes
+    (kill_rank/hang_rank/delay_collective). Under the elastic
+    supervisor this is the STABLE pod member id (CXN_MEMBER_ID) -
+    generation ranks renumber after a reshape, so a spec pinned to a
+    plain rank would re-fire on a different worker in every
+    generation; otherwise the launcher's CXN_WORKER_RANK. The env vars
+    are authoritative - they exist before jax initializes and reading
+    them cannot drag the backend up inside a fault point;
+    jax.process_index is only consulted when jax is ALREADY imported
+    (a fault point must never be the thing that initializes the
+    platform)."""
+    for key in ("CXN_MEMBER_ID", "CXN_WORKER_RANK"):
+        v = os.environ.get(key)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                return 0
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:  # noqa: BLE001 - backend not up yet: rank 0
+            return 0
+    return 0
 
 
 class _Fault:
@@ -150,6 +182,22 @@ class FaultRegistry:
                   cleanup handlers run, exactly like SIGKILL
     - ``ioerror`` raise InjectedIOError (transient; retry-absorbable)
     - ``delay``   sleep arg seconds (default 0.05)
+
+    Collective-scope (rank-aware) modes, for murdering a specific
+    worker of a multi-controller pod deterministically (the elastic
+    e2e suite - docs/FAULT_TOLERANCE.md "Elastic pod"). The SAME spec
+    is exported to every worker; only the named rank acts, and hit
+    counting stays per-process (every rank hits the same fault points
+    in the same order under SPMD, so ``@N`` picks the same step on
+    every worker):
+
+    - ``kill_rank=R``        ``kill``, only when current_rank() == R
+    - ``hang_rank=R``        wedge the calling thread forever (a live
+                             but stalled worker - the absence-alert /
+                             STALE-verdict detection path), only on
+                             rank R
+    - ``delay_collective=S`` sleep S seconds (straggler injection);
+      ``delay_collective=R:S`` restricts the delay to rank R
 
     Any other mode (``corrupt``, ...) is returned to the CALLER, which
     gives each fault point site-specific sabotage: checkpoint.py
@@ -257,6 +305,32 @@ class FaultRegistry:
                     f"injected transient IO error at {point!r} (hit {hit})")
             if f.mode == "delay":
                 time.sleep(float(f.arg) if f.arg else 0.05)
+                continue
+            if f.mode == "kill_rank":
+                if f.arg is not None and current_rank() == int(f.arg):
+                    sys.stderr.write(
+                        f"fault: killing rank {f.arg} at fault point "
+                        f"{point!r} (hit {hit})\n")
+                    sys.stderr.flush()
+                    os._exit(KILL_EXIT_CODE)
+                continue
+            if f.mode == "hang_rank":
+                if f.arg is not None and current_rank() == int(f.arg):
+                    sys.stderr.write(
+                        f"fault: hanging rank {f.arg} at fault point "
+                        f"{point!r} (hit {hit})\n")
+                    sys.stderr.flush()
+                    while True:  # wedged, not dead: detection's job
+                        time.sleep(0.5)
+                continue
+            if f.mode == "delay_collective":
+                spec = f.arg or "0.05"
+                if ":" in spec:
+                    rk, secs = spec.split(":", 1)
+                    if current_rank() == int(rk):
+                        time.sleep(float(secs))
+                else:
+                    time.sleep(float(spec))
                 continue
             return f.mode  # site-handled action (e.g. "corrupt")
         return None
