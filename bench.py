@@ -1722,7 +1722,17 @@ def _run_isolated(name: str, batch: int, steps: int, profile_dir: str,
     # no CXN_BENCH_SYNC injection: the tunnel's sync semantics drift
     # within a boot, so each child re-calibrates for its own window
     # (an explicit user-set CXN_BENCH_SYNC is inherited via os.environ)
-    env = dict(os.environ, CXN_BENCH_PROBE="0", CXN_BENCH_TIMEOUT="0")
+    # flight-recorder forensics file (telemetry/flight.py): the child
+    # arms the dispatch ring and snapshots its tail here every ~2 s,
+    # so when the parent SIGKILLs a wedged child the last snapshot
+    # still names the in-flight executable - the hung-TPU evidence
+    # every fallback round since 2026-07-30 lacked
+    import tempfile
+    flight_path = os.path.join(
+        tempfile.gettempdir(),
+        f"cxn_bench_{name}_{os.getpid()}_flight.json")
+    env = dict(os.environ, CXN_BENCH_PROBE="0", CXN_BENCH_TIMEOUT="0",
+               CXN_BENCH_FLIGHT=flight_path)
     global _CURRENT_CHILD
     try:
         with _EMIT_LOCK:
@@ -1743,11 +1753,21 @@ def _run_isolated(name: str, batch: int, steps: int, profile_dir: str,
             # the ROADMAP "reclaim the chip numbers" contract: one
             # hung backend field records an explicit timeout marker
             # and the round continues - a single wedged measurement
-            # can never zero the whole round into a CPU fallback
-            return {f"{name}_timeout": True,
-                    f"{name}_error": f"timed out after {timeout_s}s"}
+            # can never zero the whole round into a CPU fallback.
+            # The marker now ships WITH forensics: the child's last
+            # flight-recorder snapshot (in-flight executable
+            # fingerprint, bucket, age) rides the artifact next to
+            # {field}_timeout, so the post-mortem starts from "which
+            # executable", not from nothing
+            out = {f"{name}_timeout": True,
+                   f"{name}_error": f"timed out after {timeout_s}s"}
+            forensics = _read_flight_forensics(flight_path)
+            if forensics is not None:
+                out[f"{name}_forensics"] = forensics
+            return out
         finally:
             _CURRENT_CHILD = None
+            _cleanup_flight_file(flight_path)
         line = stdout.strip().splitlines()[-1] if stdout.strip() else ""
         if p.returncode == 0 and line:
             return json.loads(line)
@@ -1757,11 +1777,76 @@ def _run_isolated(name: str, batch: int, steps: int, profile_dir: str,
         return {f"{name}_error": f"{type(e).__name__}: {e}"}
 
 
+def _read_flight_forensics(path: str):
+    """The killed child's last flight snapshot, bounded for the
+    artifact (a forensics blob must not bloat the round JSON)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            snap = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(snap, dict):
+        return None
+    flights = snap.get("flight") or []
+    return {
+        "snapshot_ts": snap.get("ts"),
+        "in_flight": snap.get("in_flight") or [],
+        "flight_tail": flights[-16:],
+        "executables": (snap.get("executables") or [])[:32],
+    }
+
+
+def _cleanup_flight_file(path: str) -> None:
+    # a timed-out field's snapshot was already embedded in the
+    # fragment; a successful field's snapshot is just noise - and a
+    # child killed mid-write can leave the .tmp sibling behind
+    for p in (path, path + ".tmp"):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def _start_flight_dump(name: str) -> None:
+    """Child half of the timeout forensics: arm the dispatch flight
+    recorder (telemetry/flight.py) and snapshot its tail + the
+    executable registry to CXN_BENCH_FLIGHT every ~2 s (atomic
+    replace). A SIGKILLed child cannot flush anything at death - the
+    standing snapshot is what survives, and the parent embeds it next
+    to the {field}_timeout marker."""
+    path = os.environ.get("CXN_BENCH_FLIGHT", "")
+    if not path:
+        return
+    from cxxnet_tpu import telemetry
+    telemetry.get().flight.arm()
+
+    def _dump():
+        while True:
+            time.sleep(2.0)
+            try:
+                tel = telemetry.get()
+                # graftlint: disable=GL004 wall TIMESTAMP by design - the snapshot merges with the ts-stamped streams
+                snap = {"field": name, "ts": time.time(),
+                        "flight": tel.flight.tail(48),
+                        "in_flight": tel.flight.in_flight(),
+                        "executables": tel.executables.snapshot()}
+                tmp = path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(snap, f)
+                os.replace(tmp, path)
+            except Exception:  # noqa: BLE001 - forensics never kill the child
+                pass
+
+    threading.Thread(target=_dump, name="bench-flight-dump",
+                     daemon=True).start()
+
+
 def _child_run(name: str, batch: int, steps: int,
                profile_dir: str) -> dict:
     """--only entry point: one measurement, one JSON fragment."""
     from cxxnet_tpu.utils.platform import ensure_env_platform
     ensure_env_platform()
+    _start_flight_dump(name)
     import jax
     devices = jax.devices()
     platform = devices[0].platform

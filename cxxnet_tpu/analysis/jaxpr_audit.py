@@ -459,6 +459,35 @@ def _serve_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
     checks.append(_check(
         "serve", "no-dispatch-errors", stats["errors"] == 0,
         f"{stats['errors']} dispatch errors"))
+    # executable introspection plane (telemetry/flight.py,
+    # docs/OBSERVABILITY.md "/executables"): warmup must have
+    # registered exactly one registry entry per bucket executable,
+    # each stamped with its compile wall-time and counting the storm's
+    # dispatches - an empty or stale registry would blind the stall
+    # dump to the serving path
+    from cxxnet_tpu import telemetry
+    by_fp = {e["fingerprint"]: e
+             for e in telemetry.executables().snapshot()}
+    want = {b: srv._exec_fp.get(b) for b in srv.buckets}
+    missing = [b for b, fp in want.items() if fp not in by_fp]
+    checks.append(_check(
+        "serve", "executables-registry-lists-buckets", not missing,
+        f"buckets missing from /executables registry: {missing}"
+        if missing else f"{len(want)} bucket entries registered"))
+    if not missing:
+        no_compile = [b for b, fp in want.items()
+                      if by_fp[fp]["compile_s"] is None]
+        checks.append(_check(
+            "serve", "executables-compile-walltime-recorded",
+            not no_compile,
+            f"buckets with no compile_s: {no_compile}" if no_compile
+            else ""))
+        n_disp = sum(by_fp[fp]["dispatches"] for fp in want.values())
+        checks.append(_check(
+            "serve", "executables-dispatch-counts-accumulate",
+            n_disp >= stats["batches"],
+            f"registry counts {n_disp} dispatches over "
+            f"{stats['batches']} storm batches"))
     # artifact checks per bucket executable - donation asserted ABSENT
     # (a donated weight buffer would be freed under a concurrent
     # replica's dispatch); run AFTER the flatness checks so .lower()
@@ -917,6 +946,48 @@ def _recompile_audit(checks: List[Dict[str, Any]]) -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
+# executable introspection plane (telemetry/flight.py)
+# ---------------------------------------------------------------------------
+def _executables_audit(checks: List[Dict[str, Any]]) -> None:
+    """The sections above dispatched real train/infer/serve
+    executables, so the process-wide executable registry
+    (`/executables`, docs/OBSERVABILITY.md) must be NON-EMPTY with a
+    stable entry schema and accumulated dispatch counts - the
+    vacuity-guard stance of the other audits: an introspection plane
+    that registers nothing would pass every per-entry check."""
+    from cxxnet_tpu import telemetry
+    execs = telemetry.executables().snapshot()
+    checks.append(_check(
+        "executables", "registry-non-empty", len(execs) > 0,
+        f"{len(execs)} registered executables"))
+    kinds = {e["kind"] for e in execs}
+    checks.append(_check(
+        "executables", "covers-train-infer-serve",
+        {"train", "infer", "serve"} <= kinds,
+        f"kinds registered: {sorted(kinds)}"))
+    required = {"fingerprint", "name", "kind", "shape", "arg_bytes",
+                "device", "donated", "compile_s", "flops",
+                "cost_bytes", "out_bytes", "dispatches", "dispatch_s",
+                "last_used_ts"}
+    bad = [e.get("name", "?") for e in execs
+           if not required <= set(e)]
+    checks.append(_check(
+        "executables", "entry-schema", not bad,
+        f"entries missing schema fields: {bad[:5]}" if bad else
+        f"all {len(execs)} entries carry the full schema"))
+    dispatched = sum(1 for e in execs if e["dispatches"] > 0)
+    checks.append(_check(
+        "executables", "dispatch-counts-accumulate", dispatched > 0,
+        f"{dispatched}/{len(execs)} entries saw dispatches"))
+    donated = [e for e in execs if e["kind"] == "train"]
+    checks.append(_check(
+        "executables", "train-donation-footprint-recorded",
+        bool(donated) and all(e["donated"] for e in donated),
+        f"{len(donated)} train entries, donated="
+        f"{[e['donated'] for e in donated]}"))
+
+
+# ---------------------------------------------------------------------------
 # entry
 # ---------------------------------------------------------------------------
 def run_audit() -> Dict[str, Any]:
@@ -965,6 +1036,7 @@ def run_audit() -> Dict[str, Any]:
     cache_sizes.update(_serve_audit(checks))
     cache_sizes.update(_pass_audit(checks))
     cache_sizes.update(_quant_audit(checks))
+    _executables_audit(checks)
     return {
         "platform": jax.default_backend(),
         "jax_version": jax.__version__,

@@ -100,6 +100,12 @@ class LearnTask:
         self.alert_rules = ""
         self.alert_cmd = ""
         self.watchdog_secs = 0.0
+        # dispatch flight recorder (docs/OBSERVABILITY.md "Flight
+        # recorder"): armed automatically with any sink / metrics_port
+        # / watchdog_secs / alert_rules; flight_recorder = 1 arms the
+        # in-memory ring alone (forensics without any other plane).
+        # 0 (the default) adds nothing - byte-parity preserved
+        self.flight_recorder = 0
         self.device = "tpu"
         self.eval_train = 1
         self.test_on_server = 0
@@ -203,6 +209,11 @@ class LearnTask:
             metrics_host=self.metrics_host,
             alert_rules=self.alert_rules, alert_cmd=self.alert_cmd,
             watchdog_secs=self.watchdog_secs)
+        if self.flight_recorder:
+            # in-memory dispatch ring alone (no sink, no thread, no
+            # socket): the cheapest forensics mode - a later watchdog
+            # or /varz consumer reads what already accumulated
+            telemetry.get().flight.arm()
         if self.tuning_cache:
             # AFTER the telemetry sinks armed (the apply_task event
             # must reach the stream), BEFORE init() builds anything
@@ -318,6 +329,8 @@ class LearnTask:
             self.alert_cmd = val
         if name == "watchdog_secs":
             self.watchdog_secs = float(val)
+        if name == "flight_recorder":
+            self.flight_recorder = int(val)
         if name == "schema_check":
             self.schema_check = int(val)
         if name == "serve_rows":

@@ -22,6 +22,7 @@ forward-only executable.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -217,6 +218,10 @@ class NetTrainer:
         self._quant_stats: Optional[Dict[str, float]] = None
         self._fold_epoch = 0
         self._infer_graph_cache: Dict[Any, Any] = {}
+        # dispatch-site fingerprint cache (telemetry/flight.py): one
+        # executable-registry registration per compiled program shape;
+        # steady-state dispatches pay a dict hit
+        self._flight_fps: Dict[Any, str] = {}
         # TVM-style tuning cache (nnet/tuning.py, tools/autotune.py):
         # tuned knob values are DEFAULTS - explicitly-set config keys
         # always win (tracked per key at set_param time)
@@ -767,6 +772,9 @@ class NetTrainer:
 
     def _compile(self) -> None:
         net = self.net
+        # rebuilt executables get re-registered on first dispatch (the
+        # registry is idempotent per fingerprint; shapes key the cache)
+        self._flight_fps = {}
         # ZeRO effective stage for THIS mesh (docs/parallel.md): stages
         # >= 2 need a real 'data' axis to cut over; a single-device or
         # data-less mesh compiles the replicated stage-0 program (the
@@ -1295,6 +1303,56 @@ class NetTrainer:
                 out_shardings=rep)
 
     # ------------------------------------------------------------------
+    # dispatch introspection (telemetry/flight.py)
+    # ------------------------------------------------------------------
+    def _register_executable(self, site: str, key, kind: str,
+                             name: str, shape, arg_bytes: int,
+                             donated: int) -> str:
+        """First sight of one compiled program shape at a jit-cache
+        site: fingerprint it and register it with the executable
+        registry (the `/executables` plane + flight-recorder entries
+        name executables by this fingerprint). Callers cache the
+        result in _flight_fps so the steady state pays one dict hit."""
+        from cxxnet_tpu.telemetry.flight import fingerprint
+        fp = fingerprint(site, *key)
+        telemetry.get().executables.register(
+            fp, name=name, kind=kind, shape=str(tuple(shape)),
+            arg_bytes=int(arg_bytes), device=jax.default_backend(),
+            donated=donated)
+        self._flight_fps[key] = fp
+        return fp
+
+    @contextlib.contextmanager
+    def _flight_record(self, site: str, key, kind: str, name: str,
+                       shape, nbytes: int, donated: int = 0,
+                       bucket: Optional[int] = None, fields=None):
+        """One dispatch under flight-recorder + executable-registry
+        accounting (the single definition every trainer dispatch site
+        wraps itself in): register the program shape on first sight,
+        open a ring entry when armed, close it WITH the error if the
+        block raises (a failed dispatch must not read as a hung one -
+        only one that never returns stays in-flight), and count the
+        dispatch on success."""
+        tel = telemetry.get()
+        fp = self._flight_fps.get(key)
+        if fp is None:
+            fp = self._register_executable(
+                site, key, kind=kind, name=name, shape=shape,
+                arg_bytes=nbytes, donated=donated)
+        fl = (tel.flight.start(
+                  kind, fp=fp,
+                  bucket=shape[0] if bucket is None else bucket,
+                  nbytes=int(nbytes), fields=fields)
+              if tel.flight.enabled else None)
+        try:
+            yield
+        except BaseException as e:
+            tel.flight.fail(fl, f"{type(e).__name__}: {e}")
+            raise
+        tel.flight.finish(fl)
+        tel.executables.count_dispatch(fp)
+
+    # ------------------------------------------------------------------
     # training api
     # ------------------------------------------------------------------
     def start_round(self, round_counter: int) -> None:
@@ -1530,19 +1588,28 @@ class NetTrainer:
         fault.fault_point("collective")
         # the step is dispatched asynchronously and train metrics
         # accumulate on device - nothing here blocks on the result, so
-        # host-side input prep for batch k+1 overlaps compute of batch k
-        if self._check_nan_built:
-            # divergence guard: the per-step finite flag must be read
-            # back (a device sync - the cost of check_nan=1; staging
-            # prefetch still overlaps on its worker thread)
-            self.state, loss, finite = self._train_step(
-                self.state, gdata, gextras, glabels, gmask, rng)
-            # graftlint: disable=GL002 the guard's documented sync: the finite flag must be read back before the next step commits
-            ok = bool(np.asarray(distributed.fetch_local(finite)))
+        # host-side input prep for batch k+1 overlaps compute of batch
+        # k. The _flight_record wrapper spans the dispatch + guard
+        # readback (the sync a hung backend wedges in) so a stall dump
+        # names this exact executable.
+        ok = None
+        with self._flight_record(
+                "train_step", ("train_step", tuple(gdata.shape)),
+                kind="train", name=f"train_step@b{gdata.shape[0]}",
+                shape=gdata.shape, nbytes=gdata.nbytes, donated=1):
+            if self._check_nan_built:
+                # divergence guard: the per-step finite flag must be
+                # read back (a device sync - the cost of check_nan=1;
+                # staging prefetch still overlaps on its worker thread)
+                self.state, loss, finite = self._train_step(
+                    self.state, gdata, gextras, glabels, gmask, rng)
+                # graftlint: disable=GL002 the guard's documented sync: the finite flag must be read back before the next step commits
+                ok = bool(np.asarray(distributed.fetch_local(finite)))
+            else:
+                self.state, loss = self._train_step(
+                    self.state, gdata, gextras, glabels, gmask, rng)
+        if ok is not None:
             self._guard_step(ok, self._step_counter - 1)
-        else:
-            self.state, loss = self._train_step(
-                self.state, gdata, gextras, glabels, gmask, rng)
         # host mirror of the device epoch counter (one update per
         # update_period steps) - avoids forcing a device sync per step;
         # guard-dropped steps never advanced the device counters
@@ -1615,16 +1682,29 @@ class NetTrainer:
         # same collective-scope fault point as the streamed path: one
         # hit per DISPATCH (K microsteps), still rank-deterministic
         fault.fault_point("collective")
-        self.state, losses, finites = self._train_chunk(
-            self.state, chunk.data, chunk.extras, chunk.labels,
-            chunk.mask, step_idx, base_rng)
-        if self._check_nan_built:
-            # ONE readback per chunk (vs one per step streamed) - the
-            # whole point of the fused dispatch; the guard then walks
-            # the per-microstep flags in order, so drop counts and
-            # consecutive-failure accounting match streaming exactly
-            # graftlint: disable=GL002 ONE guard readback per K-step chunk - the fused dispatch's whole point
-            fin = np.asarray(distributed.fetch_local(finites))
+        # flight-recorder entry: one per K-step chunk dispatch, same
+        # contract as update()'s (in-flight across the guard readback)
+        fin = None
+        with self._flight_record(
+                "train_chunk",
+                ("train_chunk", k, tuple(chunk.data.shape)),
+                kind="train",
+                name=f"train_chunk@K{k}b{chunk.data.shape[1]}",
+                shape=chunk.data.shape, nbytes=chunk.data.nbytes,
+                donated=1, bucket=chunk.data.shape[1],
+                fields={"steps": k}):
+            self.state, losses, finites = self._train_chunk(
+                self.state, chunk.data, chunk.extras, chunk.labels,
+                chunk.mask, step_idx, base_rng)
+            if self._check_nan_built:
+                # ONE readback per chunk (vs one per step streamed) -
+                # the whole point of the fused dispatch; the guard then
+                # walks the per-microstep flags in order, so drop
+                # counts and consecutive-failure accounting match
+                # streaming exactly
+                # graftlint: disable=GL002 ONE guard readback per K-step chunk - the fused dispatch's whole point
+                fin = np.asarray(distributed.fetch_local(finites))
+        if fin is not None:
             for i in range(k):
                 self._guard_step(bool(fin[i]), first_step + i)
         self.epoch = self._epoch_base + (
@@ -1715,10 +1795,16 @@ class NetTrainer:
         gdata = self._put_data(data)
         shd = self._batch_sharded
         gextras = tuple(distributed.put_global(e, shd) for e in extras)
-        outs = self._eval_step(self.state["params"], gdata, gextras)
-        valid = int(mask.sum())
-        return {nid: distributed.fetch_local(v)[:valid]
-                for nid, v in outs.items()}
+        with self._flight_record(
+                "eval_step", ("eval_step", tuple(gdata.shape)),
+                kind="eval", name=f"eval_step@b{gdata.shape[0]}",
+                shape=gdata.shape, nbytes=gdata.nbytes):
+            outs = self._eval_step(self.state["params"], gdata,
+                                   gextras)
+            valid = int(mask.sum())
+            got = {nid: distributed.fetch_local(v)[:valid]
+                   for nid, v in outs.items()}
+        return got
 
     def _infer_node(self, batch: DataBatch, node: int) -> np.ndarray:
         """One node's output rows for a batch via the dedicated
@@ -1742,9 +1828,16 @@ class NetTrainer:
                 gdata, gextras,
                 distributed.put_global(np.asarray(mask, np.float32),
                                        shd))
-        out = self._infer_fn(node)(self.state["params"], gdata, gextras)
-        valid = int(mask.sum())
-        return distributed.fetch_local(out)[:valid]
+        with self._flight_record(
+                "infer",
+                ("infer", node, self._fold_epoch, tuple(gdata.shape)),
+                kind="infer", name=f"infer:n{node}@b{gdata.shape[0]}",
+                shape=gdata.shape, nbytes=gdata.nbytes):
+            out = self._infer_fn(node)(self.state["params"], gdata,
+                                       gextras)
+            valid = int(mask.sum())
+            got = distributed.fetch_local(out)[:valid]
+        return got
 
     def stage_infer_rows(self, data: np.ndarray, extras: Sequence = ()):
         """Stage an ARBITRARY-row-count inference input under the infer
@@ -2151,15 +2244,23 @@ class NetTrainer:
                     jax.random.PRNGKey(self.seed + 200), step)
                 step += 1
                 labels = self._label_fields(label.astype(np.float32))
-                per_batch.append(self._eval_metric_step(
-                    self.state["params"],
-                    self._put_data(data),
-                    tuple(distributed.put_global(e, shd)
-                          for e in extras),
-                    {k: distributed.put_global(v, shd)
-                     for k, v in labels.items()},
-                    distributed.put_global(mask.astype(np.float32), shd),
-                    rng))
+                gdata = self._put_data(data)
+                with self._flight_record(
+                        "eval_metric",
+                        ("eval_metric", tuple(gdata.shape)),
+                        kind="eval",
+                        name=f"eval_metric@b{gdata.shape[0]}",
+                        shape=gdata.shape, nbytes=gdata.nbytes):
+                    per_batch.append(self._eval_metric_step(
+                        self.state["params"],
+                        gdata,
+                        tuple(distributed.put_global(e, shd)
+                              for e in extras),
+                        {k: distributed.put_global(v, shd)
+                         for k, v in labels.items()},
+                        distributed.put_global(
+                            mask.astype(np.float32), shd),
+                        rng))
                 # eval progress beacon: round-boundary evals can
                 # dwarf watchdog_secs without being a hang
                 telemetry.beacon("eval.step")

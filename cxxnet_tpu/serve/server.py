@@ -31,17 +31,28 @@ that driver:
   (fill-or-timeout), so p99 latency stays bounded under low load.
 
 Telemetry (docs/OBSERVABILITY.md): `serve.latency_s` histogram
-(p50/p99 through the registry), `serve.queue_depth` gauge,
+(p50/p99 through the registry), the `serve.queue_s` / `serve.device_s`
+per-request breakdown (request tracing: queue = submit -> dispatch,
+incl. the fill-or-timeout coalesce wait; device = dispatch -> result
+readback), the `serve.request_rows` Prometheus
+histogram over the bucket ladder, `serve.queue_depth` gauge,
 `serve.requests`/`serve.rows`/`serve.batches`/`serve.padding_rows`/
 `serve.errors` counters. These accumulate unconditionally (they are
 the product surface, queried via `Server.stats()`), like the fault
 counters - no per-row device sync is added beyond the result readback
-serving inherently requires.
+serving inherently requires. With the observability plane armed every
+dispatch additionally lands in the flight recorder (executable
+fingerprint + bucket + trace id - telemetry/flight.py), each warmed
+bucket registers on `/executables`, and resolved requests emit `trace`
+events that `tools/trace_export.py` renders to Perfetto-loadable
+Chrome trace JSON.
 """
 
 from __future__ import annotations
 
 import collections
+import itertools
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -49,6 +60,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cxxnet_tpu import telemetry
+from cxxnet_tpu.telemetry.flight import fingerprint as exec_fingerprint
 
 
 def bucket_sizes(max_batch: int, data_axis: int = 1) -> Tuple[int, ...]:
@@ -192,14 +204,26 @@ class _JoinedFuture:
 
 
 class _WorkItem:
-    __slots__ = ("data", "extras", "n", "t_submit", "future")
+    __slots__ = ("data", "extras", "n", "t_submit", "future",
+                 "trace", "part", "nparts", "t_collect")
 
-    def __init__(self, data, extras, t_submit) -> None:
+    def __init__(self, data, extras, t_submit, trace="",
+                 part=0, nparts=1) -> None:
         self.data = data
         self.extras = extras
         self.n = data.shape[0]
         self.t_submit = t_submit
         self.future = _Future()
+        # end-to-end request tracing (docs/OBSERVABILITY.md "Request
+        # tracing"): the trace id minted at submit(), the part index
+        # for oversize requests that split, and the coalesce time a
+        # dispatcher stamps when it pops the item; the queue/device
+        # latency cut itself is the DISPATCH stamp (_run_batch) -
+        # the fill wait after the pop is still queue time
+        self.trace = trace
+        self.part = part
+        self.nparts = nparts
+        self.t_collect = 0.0
 
 
 class Server:
@@ -282,6 +306,15 @@ class Server:
         self.metrics_port = metrics_port
         self.metrics_host = metrics_host
         self.metrics_server = None
+        if metrics_port is not None:
+            # the attached exposition endpoint is a flight-recorder
+            # consumer (it serves the /varz tail and /executables) -
+            # arm the recorder for this Server's lifetime, the same
+            # rule arm_observability applies to the process-wide
+            # plane. Armed HERE (not in start()) so warmup()'s cost
+            # enrichment sees it: warmup conventionally runs before
+            # start(). stop() re-derives from the remaining consumers.
+            telemetry.get().flight.enabled = True
         self._cond = threading.Condition()
         # admission state: the queue, its row count and the drain flag
         # move together under the condition (checked statically -
@@ -318,6 +351,20 @@ class Server:
         # guarded-by: self._lock
         self._size_hist: Dict[int, int] = {}
         self._lat = telemetry.Histogram()
+        # per-request queue-vs-device decomposition (request tracing):
+        # queue = submit -> coalesce, device = coalesce -> result
+        self._qlat = telemetry.Histogram()
+        self._dlat = telemetry.Histogram()
+        # request-size distribution as a proper Prometheus histogram
+        # on /metrics (bounds = this Server's bucket ladder); the
+        # dict-shaped stats()["request_sizes"] stays for the autotuner
+        self._req_hist = telemetry.get().registry.bucket_histogram(
+            "serve.request_rows", bounds=self.buckets)
+        # request-trace ids minted at submit(); executable
+        # fingerprints per warmed bucket (filled by warmup) feed the
+        # flight recorder + /executables registry (telemetry/flight.py)
+        self._trace_seq = itertools.count(1)
+        self._exec_fp: Dict[int, str] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def warmup(self) -> float:
@@ -327,12 +374,38 @@ class Server:
         import jax
         t0 = time.perf_counter()
         params = self.trainer.state["params"]
+        tel = telemetry.get()
+        epoch = getattr(self.trainer, "_fold_epoch", 0)
         for b in self.buckets:
             data = np.zeros((b,) + self._input_dims, np.float32)
             extras = [np.zeros((b,) + d, np.float32)
                       for d in self._extra_dims]
             gdata, gextras = self.trainer.stage_infer_rows(data, extras)
+            tb = time.perf_counter()
             jax.block_until_ready(self._fn(params, gdata, gextras))
+            compile_s = time.perf_counter() - tb
+            # executable registry (telemetry/flight.py): one entry per
+            # warmed bucket program shape, stamped with its compile
+            # wall-time (warmup's block IS the compile window). The
+            # fingerprint is what flight entries and stall dumps name.
+            fp = exec_fingerprint(
+                "serve.infer", self.node, b, self._input_dims,
+                epoch)
+            self._exec_fp[b] = fp
+            tel.executables.register(
+                fp, name=f"serve.infer:b{b}", kind="serve",
+                shape=str((b,) + self._input_dims),
+                arg_bytes=int(data.nbytes
+                              + sum(e.nbytes for e in extras)),
+                device=jax.default_backend(), donated=0,
+                compile_s=compile_s)
+            if tel.flight.enabled:
+                # armed plane: enrich with XLA cost analysis + output
+                # footprint (one extra trace/lowering per bucket,
+                # sanctioned here in the warmup window; the jit cache
+                # the zero-recompile audit counts is untouched)
+                tel.executables.enrich(fp, self._fn,
+                                       (params, gdata, gextras))
         self.warmup_s = time.perf_counter() - t0
         telemetry.observe("serve.warmup_s", self.warmup_s)
         telemetry.event("serve", op="warmup", buckets=list(self.buckets),
@@ -392,6 +465,11 @@ class Server:
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None
+        if self.metrics_port is not None:
+            # this Server's endpoint was a flight consumer; re-derive
+            # the recorder's armed state from whatever remains (sinks,
+            # the process-wide plane, an explicit flight_recorder=1)
+            telemetry.get()._refresh_flight()
         telemetry.set_gauge("serve.queue_depth", 0.0)
         stats = self.stats()
         telemetry.event("serve", op="stop", **{
@@ -435,11 +513,19 @@ class Server:
             if e.shape[0] != data.shape[0]:
                 raise ValueError("extras must be row-aligned with data")
         t_submit = time.monotonic()
+        # request trace id (docs/OBSERVABILITY.md "Request tracing"):
+        # minted once per submit and shared by every split part, so an
+        # oversize request renders as ONE span tree in the exported
+        # Chrome trace; pid-scoped so multi-process traces merge
+        trace = f"{os.getpid():x}-{next(self._trace_seq):06d}"
+        nparts = -(-data.shape[0] // self.max_batch)
         items = []
-        for lo in range(0, data.shape[0], self.max_batch):
+        for part, lo in enumerate(
+                range(0, data.shape[0], self.max_batch)):
             hi = lo + self.max_batch
             items.append(_WorkItem(
-                data[lo:hi], [e[lo:hi] for e in extras], t_submit))
+                data[lo:hi], [e[lo:hi] for e in extras], t_submit,
+                trace=trace, part=part, nparts=nparts))
         with self._cond:
             if self._draining:
                 raise RuntimeError("server is stopping")
@@ -453,6 +539,8 @@ class Server:
             self._n_rows += data.shape[0]
             for it in items:
                 self._size_hist[it.n] = self._size_hist.get(it.n, 0) + 1
+        for it in items:
+            self._req_hist.observe(it.n)
         telemetry.inc("serve.requests")
         telemetry.inc("serve.rows", data.shape[0])
         telemetry.set_gauge("serve.queue_depth", depth)
@@ -472,6 +560,9 @@ class Server:
                     return None
                 self._cond.wait(0.05)
             first = self._queue.popleft()
+            # coalesce stamp: end of this item's queue phase (request
+            # tracing's queue-vs-device cut)
+            first.t_collect = time.monotonic()
             items = [first]
             total = first.n
             deadline = first.t_submit + self.max_wait_ms / 1e3
@@ -479,6 +570,7 @@ class Server:
                 if self._queue:
                     if self._queue[0].n <= self.max_batch - total:
                         it = self._queue.popleft()
+                        it.t_collect = time.monotonic()
                         items.append(it)
                         total += it.n
                         continue
@@ -507,17 +599,65 @@ class Server:
             extras = [np.concatenate(
                 [e, np.zeros((pad,) + e.shape[1:], e.dtype)], axis=0)
                 for e in extras]
-        gdata, gextras = self.trainer.stage_infer_rows(data, extras)
-        out = self._fn(self.trainer.state["params"], gdata, gextras)
-        rows = distributed.fetch_local(out)
+        tel = telemetry.get()
+        fp = self._exec_fp.get(bucket, "")
+        fl = None
+        if tel.flight.enabled:
+            # dispatch flight record: opened BEFORE staging (a hung
+            # backend blocks inside device_put / the dispatch / the
+            # readback below, leaving this entry in-flight with the
+            # exact executable fingerprint + request trace on it)
+            fl = tel.flight.start(
+                "serve", fp=fp, bucket=bucket, nbytes=int(data.nbytes),
+                trace=items[0].trace,
+                fields={"rows": total, "requests": len(items)})
+        t_dispatch = time.monotonic()
+        try:
+            gdata, gextras = self.trainer.stage_infer_rows(data, extras)
+            out = self._fn(self.trainer.state["params"], gdata, gextras)
+            rows = distributed.fetch_local(out)
+        except BaseException as e:
+            # a FAILED dispatch must not read as a hung one: the
+            # replica recovers and keeps serving, so close the flight
+            # entry with the error instead of leaving it in-flight
+            # forever (only a dispatch that never returns stays open)
+            tel.flight.fail(fl, f"{type(e).__name__}: {e}")
+            raise
         rows = rows.reshape(bucket, -1)
         t_done = time.monotonic()
+        tel.flight.finish(fl)
+        if fp:
+            tel.executables.count_dispatch(fp, secs=t_done - t_dispatch)
         off = 0
         for it in items:
             it.future._set(rows[off:off + it.n])
             off += it.n
             self._lat.observe(t_done - it.t_submit)
             telemetry.observe("serve.latency_s", t_done - it.t_submit)
+            # queue-vs-device breakdown per traced request part: the
+            # cut is at DISPATCH, not at queue-pop - the fill-or-
+            # timeout coalesce wait after the pop is host-side
+            # admission latency and must not be billed to the device
+            # (it would misdirect a p99 investigation toward the
+            # accelerator); t_collect still rides the trace record so
+            # the export can render the coalesce boundary
+            queue_s = max(t_dispatch - it.t_submit, 0.0)
+            device_s = max(t_done - t_dispatch, 0.0)
+            self._qlat.observe(queue_s)
+            self._dlat.observe(device_s)
+            telemetry.observe("serve.queue_s", queue_s)
+            telemetry.observe("serve.device_s", device_s)
+            # one trace record per resolved part (no-op with no event
+            # sink armed): the complete span set tools/trace_export.py
+            # renders to Chrome trace-event JSON
+            tel.event("trace", trace=it.trace, part=it.part,
+                      parts=it.nparts, rows=it.n, bucket=bucket,
+                      fp=fp, t_submit=round(it.t_submit, 6),
+                      t_collect=round(it.t_collect, 6),
+                      t_dispatch=round(t_dispatch, 6),
+                      t_done=round(t_done, 6),
+                      queue_ms=round(queue_s * 1e3, 3),
+                      device_ms=round(device_s * 1e3, 3))
         with self._lock:
             self._n_batches += 1
             self._n_padding += bucket - total
@@ -563,7 +703,11 @@ class Server:
                 "request_sizes": dict(self._size_hist),
             }
         out["warmup_s"] = round(self.warmup_s, 4)
-        for q, key in ((50, "latency_p50_ms"), (99, "latency_p99_ms")):
-            v = self._lat.percentile(q)
-            out[key] = round(v * 1e3, 3) if v == v else None
+        for hist, stem in ((self._lat, "latency"),
+                           (self._qlat, "queue"),
+                           (self._dlat, "device")):
+            for q in (50, 99):
+                v = hist.percentile(q)
+                out[f"{stem}_p{q}_ms"] = (round(v * 1e3, 3)
+                                          if v == v else None)
         return out

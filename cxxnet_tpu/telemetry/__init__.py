@@ -38,19 +38,23 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from cxxnet_tpu.telemetry.flight import (
+    ExecutableRegistry, FlightRecorder)
 from cxxnet_tpu.telemetry.health import HealthState
 from cxxnet_tpu.telemetry.registry import (
-    Counter, Gauge, Histogram, MetricsRegistry)
+    BucketHistogram, Counter, Gauge, Histogram, MetricsRegistry)
 from cxxnet_tpu.telemetry.sink import LineSink, read_jsonl
 
 __all__ = [
-    "Telemetry", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "Telemetry", "Counter", "Gauge", "Histogram", "BucketHistogram",
+    "MetricsRegistry", "FlightRecorder", "ExecutableRegistry",
     "HealthState", "LineSink", "read_jsonl", "get", "configure",
     "close", "enabled", "metrics_enabled", "counter", "gauge",
     "histogram", "inc", "set_gauge", "observe", "span", "event",
     "emit_metrics", "stdout", "stderr", "set_tags", "beacon",
-    "beacons", "recent_spans", "arm_observability",
-    "disarm_observability", "health", "reset_for_tests",
+    "beacons", "recent_spans", "flight", "executables",
+    "arm_observability", "disarm_observability", "health",
+    "reset_for_tests",
 ]
 
 # completed spans kept for the watchdog's stall dump ("what ran last")
@@ -142,6 +146,13 @@ class Telemetry:
         self._http = None
         self._alerts = None
         self._watchdog = None
+        # dispatch flight recorder + executable registry (flight.py):
+        # the recorder arms with the plane (any sink / http / watchdog
+        # / alerts, or flight_recorder=1) - unarmed dispatch sites pay
+        # one attribute check; the registry registers unconditionally
+        # (once per compiled program shape, no output)
+        self.flight = FlightRecorder()
+        self.executables = ExecutableRegistry()
         self._tags: Dict[str, object] = {
             "host": socket.gethostname(),
             "pid": os.getpid(),
@@ -176,6 +187,21 @@ class Telemetry:
         self.heartbeat_secs = float(heartbeat_secs or 0.0)
         if self.heartbeat_secs > 0 and (self._log or self._metrics):
             self._start_heartbeat()
+        self._refresh_flight()
+
+    def _refresh_flight(self) -> None:
+        """Re-derive the flight recorder's armed state: any consumer
+        of its ring (a sink to mirror trace events into, the /varz
+        and /executables endpoints, the watchdog's stall dump, an
+        alert engine's forensics) arms it; an explicit
+        ``flight_recorder = 1`` keeps it armed with everything else
+        off. With no consumer the recorder stays disabled and every
+        dispatch site pays one attribute check - the byte-parity
+        contract's zero-overhead path."""
+        self.flight.enabled = bool(
+            self._log is not None or self._metrics is not None
+            or self._http is not None or self._watchdog is not None
+            or self._alerts is not None or self.flight.explicit)
 
     def set_tags(self, **tags) -> None:
         """Late tag refinement (e.g. `proc` once jax.process_index()
@@ -248,6 +274,7 @@ class Telemetry:
             self._http.start()
             self.event("observability", op="http_start",
                        port=self._http.port, host=self._http.host)
+        self._refresh_flight()
         return self._http
 
     def disarm_observability(self) -> None:
@@ -263,6 +290,7 @@ class Telemetry:
         if self._http is not None:
             self._http.close()
             self._http = None
+        self._refresh_flight()
 
     def close(self) -> None:
         """Tear down the observability plane (watchdog/alerts/http),
@@ -276,6 +304,7 @@ class Telemetry:
         if self._metrics is not None:
             self._metrics.close()
             self._metrics = None
+        self._refresh_flight()
 
     @property
     def enabled(self) -> bool:
@@ -536,6 +565,14 @@ def recent_spans():
     return _TEL.recent_spans()
 
 
+def flight() -> FlightRecorder:
+    return _TEL.flight
+
+
+def executables() -> ExecutableRegistry:
+    return _TEL.executables
+
+
 def arm_observability(**kwargs):
     return _TEL.arm_observability(**kwargs)
 
@@ -559,6 +596,8 @@ def reset_for_tests() -> None:
     with _TEL._beacon_lock:
         _TEL._beacons = {}
     _TEL._recent_spans.clear()
+    _TEL.flight.reset()
+    _TEL.executables.reset()
     with _TEL._emit_lock:
         _TEL._finalized = False
     _TEL._hb_waiter = None

@@ -21,7 +21,15 @@ serving-transport roadmap item) exposing
 - ``/varz``: one JSON object, byte-compatible with a metrics-stream
   record (``{ts, host, pid, proc, ..., kind: "varz", metrics: {...}}``)
   so ``tools/agg.py`` can scrape live processes and file tails with
-  the same parser.
+  the same parser; with the flight recorder armed the record
+  additionally carries a ``flight`` tail (recent + in-flight
+  dispatches - docs/OBSERVABILITY.md "Flight recorder").
+- ``/executables``: the executable introspection plane (flight.py
+  registry): one JSON entry per compiled program shape - fingerprint,
+  site name/kind, compile wall-time, XLA cost-analysis flops/bytes,
+  output/donation footprint and dispatch counts - plus the currently
+  in-flight dispatches. The same facts export as labeled Prometheus
+  series (``cxxnet_executable_*{fingerprint=...}``) on ``/metrics``.
 
 Armed only by ``metrics_port=`` (or ``Server(metrics_port=...)``);
 with the key unset this module is never imported - the CLI
@@ -37,7 +45,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from cxxnet_tpu.telemetry.registry import Counter, Gauge, Histogram
+from cxxnet_tpu.telemetry.registry import (
+    BucketHistogram, Counter, Gauge, Histogram)
 from cxxnet_tpu.telemetry.sink import _sanitize
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -98,6 +107,14 @@ def render_prometheus(tel) -> str:
         elif isinstance(inst, Gauge):
             lines.append(f"# TYPE {pname} gauge")
             lines.append(f"{pname} {_fmt_value(inst.value)}")
+        elif isinstance(inst, BucketHistogram):
+            snap = inst.snapshot()
+            lines.append(f"# TYPE {pname} histogram")
+            for le, cum in snap["buckets"].items():
+                lines.append(f'{pname}_bucket{{le="{le}"}} '
+                             f"{_fmt_value(cum)}")
+            lines.append(f"{pname}_sum {_fmt_value(snap['sum'])}")
+            lines.append(f"{pname}_count {_fmt_value(snap['count'])}")
         elif isinstance(inst, Histogram):
             snap = inst.snapshot()
             lines.append(f"# TYPE {pname} summary")
@@ -107,7 +124,45 @@ def render_prometheus(tel) -> str:
                          f'{_fmt_value(snap["p99"])}')
             lines.append(f"{pname}_sum {_fmt_value(snap['sum'])}")
             lines.append(f"{pname}_count {_fmt_value(snap['count'])}")
+    lines.extend(_render_executables(tel))
     return "\n".join(lines) + "\n"
+
+
+def _render_executables(tel) -> List[str]:
+    """Per-executable introspection series (flight.py registry) plus
+    the flight-recorder liveness gauges. Labeled by fingerprint so a
+    multi-bucket serving process exports one series per warmed
+    program shape - the Grafana twin of `/executables`."""
+    execs = tel.executables.snapshot()
+    lines: List[str] = []
+    if execs:
+        lines.append("# TYPE cxxnet_executable_dispatches_total counter")
+        for e in execs:
+            lab = (f'fingerprint="{prom_label_escape(e["fingerprint"])}"'
+                   f',name="{prom_label_escape(e["name"])}"'
+                   f',kind="{prom_label_escape(e["kind"])}"')
+            lines.append("cxxnet_executable_dispatches_total{%s} %s"
+                         % (lab, _fmt_value(e["dispatches"])))
+        for field, pname in (("compile_s",
+                              "cxxnet_executable_compile_seconds"),
+                             ("flops", "cxxnet_executable_flops"),
+                             ("cost_bytes",
+                              "cxxnet_executable_cost_bytes")):
+            rows = [e for e in execs if e.get(field) is not None]
+            if not rows:
+                continue
+            lines.append(f"# TYPE {pname} gauge")
+            for e in rows:
+                lab = (f'fingerprint='
+                       f'"{prom_label_escape(e["fingerprint"])}"'
+                       f',name="{prom_label_escape(e["name"])}"')
+                lines.append("%s{%s} %s"
+                             % (pname, lab, _fmt_value(e[field])))
+    if tel.flight.enabled:
+        lines.append("# TYPE cxxnet_flight_inflight gauge")
+        lines.append("cxxnet_flight_inflight "
+                     + _fmt_value(len(tel.flight.in_flight())))
+    return lines
 
 
 # one exposition line: comment, or `name[{labels}] value` where value
@@ -156,6 +211,20 @@ def _make_handler(tel):
                                PROM_CONTENT_TYPE)
                 elif path == "/varz":
                     rec = tel.snapshot_record(kind="varz")
+                    if tel.flight.enabled:
+                        # flight-recorder tail rides the varz record
+                        # (extra key; the metrics-stream schema's
+                        # parsers read known keys): a remote operator
+                        # sees the in-flight dispatch of a hung host
+                        # without shell access to it
+                        rec["flight"] = tel.flight.tail(32)
+                    self._send(200, json.dumps(
+                        _sanitize(rec), separators=(",", ":"),
+                        default=str).encode(), "application/json")
+                elif path == "/executables":
+                    rec = tel._record("executables", {
+                        "executables": tel.executables.snapshot(),
+                        "in_flight": tel.flight.in_flight()})
                     self._send(200, json.dumps(
                         _sanitize(rec), separators=(",", ":"),
                         default=str).encode(), "application/json")

@@ -141,7 +141,54 @@ class Histogram:
         return out
 
 
-Instrument = Union[Counter, Gauge, Histogram]
+class BucketHistogram:
+    """Fixed-bound cumulative-bucket histogram - the Prometheus
+    ``histogram`` type (``_bucket{le=...}`` series), unlike Histogram
+    above which exports as a quantile summary. Used where the value
+    domain is known at creation (the Server's request-size
+    distribution over its bucket ladder) so a scrape gets the real
+    shape, not two quantiles."""
+
+    __slots__ = ("_lock", "bounds", "count", "sum", "_counts")
+
+    def __init__(self, bounds) -> None:
+        self._lock = threading.Lock()
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        if not self.bounds:
+            raise ValueError("BucketHistogram needs >= 1 bound")
+        self.count = 0
+        self.sum = 0.0
+        # per-bound NON-cumulative counts + one overflow slot;
+        # snapshot() accumulates (the export wants cumulative le=)
+        self._counts = [0] * (len(self.bounds) + 1)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counts = list(self._counts)
+            count, total = self.count, self.sum
+        buckets: Dict[str, int] = {}
+        acc = 0
+        for b, c in zip(self.bounds, counts):
+            acc += c
+            key = str(int(b)) if b == int(b) else repr(b)
+            buckets[key] = acc
+        buckets["+Inf"] = count
+        return {"count": count, "sum": total, "buckets": buckets}
+
+
+Instrument = Union[Counter, Gauge, Histogram, BucketHistogram]
 
 
 class MetricsRegistry:
@@ -175,6 +222,21 @@ class MetricsRegistry:
 
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
+
+    def bucket_histogram(self, name: str, bounds=()) -> BucketHistogram:
+        """Idempotent per name like the other kinds; the FIRST
+        creation's bounds win (a second Server re-requesting the
+        instrument must not silently re-bucket the series mid-scrape)."""
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = BucketHistogram(bounds)
+                self._instruments[name] = inst
+            elif not isinstance(inst, BucketHistogram):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, not BucketHistogram")
+            return inst
 
     def get(self, name: str) -> Optional[Instrument]:
         with self._lock:

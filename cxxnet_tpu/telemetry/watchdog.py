@@ -9,8 +9,10 @@ the newest beacon's age. When nothing has progressed for
 ``watchdog_secs``:
 
 1. every Python thread's stack is captured (``sys._current_frames``)
-   together with the last N completed spans - exactly where the hang
-   is and what ran last;
+   together with the last N completed spans and the flight recorder's
+   dispatch tail (telemetry/flight.py) - exactly where the hang is,
+   what ran last, and WHICH executable (fingerprint, bucket, request
+   trace id) is still in flight;
 2. the dump goes to **stderr** and, as a structured ``watchdog``
    event (op=``stall_dump``, with the stacks and spans as fields), to
    the event stream - so a post-mortem needs only the JSONL;
@@ -134,19 +136,28 @@ class Watchdog:
         spans = self.tel.recent_spans()[-self.dump_spans:]
         span_lines = "".join(
             f"  {s['secs']:.4f}s {s['name']}\n" for s in spans)
+        # flight-recorder tail (telemetry/flight.py): the stall dump's
+        # "which executable" half - in-flight entries name the exact
+        # wedged dispatch (fingerprint, bucket, request trace id) the
+        # thread stacks alone cannot. Same one-dump-per-episode rule:
+        # this runs only on the stalled-edge transition above.
+        flights = self.tel.flight.tail(self.dump_spans)
         text = (
             f"watchdog: no progress for {age:.1f}s "
             f"(threshold {self.stall_secs:g}s); dumping "
             f"{stacks.count('--- thread')} thread stacks\n"
             f"{stacks}"
-            f"last {len(spans)} spans (newest last):\n{span_lines}")
+            f"last {len(spans)} spans (newest last):\n{span_lines}"
+            f"last {len(flights)} dispatches (flight recorder, "
+            f"newest last):\n"
+            f"{self.tel.flight.format_tail(rows=flights)}")
         # stderr first (the operator's console), then the structured
         # event - both BEFORE the absence alert fires on the same
         # stall, since the alert engine judges beacon age with a
         # threshold that should sit above watchdog_secs
         self.tel.stderr(text, event_kind="watchdog", op="stall_dump",
                         stalled_secs=round(age, 3), stacks=stacks,
-                        spans=spans)
+                        spans=spans, flights=flights)
         self.tel.health.set_unhealthy(
             "watchdog",
             f"no progress for {age:.1f}s "

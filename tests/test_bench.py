@@ -13,6 +13,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -292,6 +293,77 @@ def test_run_isolated_wraps_failures(monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     frag = bench._run_isolated("no_such_measurement", 4, 1, "", 120)
     assert "no_such_measurement_error" in frag
+
+
+def test_run_isolated_timeout_embeds_flight_forensics(tmp_path,
+                                                      monkeypatch):
+    """A hung child killed at the per-field timeout must leave a
+    forensics payload next to the {field}_timeout marker: the child's
+    last flight-recorder snapshot (the CXN_BENCH_FLIGHT file) names
+    the in-flight executable the parent could never ask it for."""
+    import bench
+    fake = tmp_path / "fake_child.py"
+    fake.write_text(
+        "import json, os, time\n"
+        "path = os.environ['CXN_BENCH_FLIGHT']\n"
+        "ent = {'seq': 0, 'kind': 'train', 'fp': 'wedged123',\n"
+        "       'bucket': 4, 'in_flight': True, 'age_s': 9.9}\n"
+        "snap = {'field': 'e2e', 'ts': 1.0, 'flight': [ent],\n"
+        "        'in_flight': [ent],\n"
+        "        'executables': [{'fingerprint': 'wedged123',\n"
+        "                         'name': 'train_step@b4'}]}\n"
+        "with open(path + '.tmp', 'w') as f:\n"
+        "    json.dump(snap, f)\n"
+        "os.replace(path + '.tmp', path)\n"
+        "time.sleep(120)\n")
+    monkeypatch.setattr(bench, "_BENCH_PATH", str(fake))
+    frag = bench._run_isolated("e2e", 4, 1, "", 8.0)
+    assert frag["e2e_timeout"] is True
+    forensics = frag["e2e_forensics"]
+    assert forensics["in_flight"][0]["fp"] == "wedged123"
+    assert forensics["flight_tail"][-1]["in_flight"] is True
+    assert forensics["executables"][0]["name"] == "train_step@b4"
+
+
+def test_read_flight_forensics_bounds_and_garbage(tmp_path):
+    import bench
+    # garbage / missing file degrade to None, never raise
+    assert bench._read_flight_forensics(str(tmp_path / "nope")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{torn")
+    assert bench._read_flight_forensics(str(bad)) is None
+    big = tmp_path / "big.json"
+    big.write_text(json.dumps({
+        "ts": 5.0,
+        "flight": [{"seq": i} for i in range(100)],
+        "executables": [{"fingerprint": str(i)} for i in range(100)],
+    }))
+    out = bench._read_flight_forensics(str(big))
+    # bounded: the artifact must not bloat the round JSON
+    assert len(out["flight_tail"]) == 16
+    assert out["flight_tail"][-1]["seq"] == 99
+    assert len(out["executables"]) == 32
+    assert out["snapshot_ts"] == 5.0
+
+
+def test_child_flight_dump_writes_snapshots(tmp_path, monkeypatch):
+    """The child half: _start_flight_dump arms the recorder and
+    snapshots the ring to CXN_BENCH_FLIGHT (atomic replace)."""
+    import bench
+    from cxxnet_tpu import telemetry
+    telemetry.reset_for_tests()
+    path = tmp_path / "flight.json"
+    monkeypatch.setenv("CXN_BENCH_FLIGHT", str(path))
+    bench._start_flight_dump("compute")
+    assert telemetry.flight().enabled
+    telemetry.flight().start("train", fp="live1", bucket=4)
+    deadline = time.monotonic() + 10.0
+    while not path.exists() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    snap = json.loads(path.read_text())
+    assert snap["field"] == "compute"
+    assert snap["in_flight"][0]["fp"] == "live1"
+    telemetry.reset_for_tests()
 
 
 def test_child_only_mode_emits_fragment(tmp_path, monkeypatch):

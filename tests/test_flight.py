@@ -1,0 +1,675 @@
+"""Dispatch flight recorder, executable registry, request tracing
+(telemetry/flight.py, docs/OBSERVABILITY.md third observability tier).
+
+Covers: ring semantics (wrap, in-flight marking, tail order), the
+armed/disarmed contract (unarmed dispatch sites record NOTHING and the
+CLI stays byte-identical - pinned by a subprocess A/B), executable
+registration at the real trainer/serve jit-cache sites, the
+``/executables`` endpoint schema, Prometheus exposition grammar for
+every new series (per-executable gauges, the ``serve.request_rows``
+bucket histogram, the flight gauge), trace_id propagation through an
+oversize split request, the Chrome trace export's complete span
+trees, and the watchdog stall dump's flight section under the
+one-dump-per-episode rule.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import telemetry
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.serve import Server
+from cxxnet_tpu.telemetry import Telemetry
+from cxxnet_tpu.telemetry.flight import (
+    ExecutableRegistry, FlightRecorder, fingerprint)
+from cxxnet_tpu.telemetry.http import (
+    ObservabilityServer, render_prometheus, validate_exposition)
+from cxxnet_tpu.telemetry.registry import BucketHistogram
+from cxxnet_tpu.telemetry.sink import read_jsonl
+from cxxnet_tpu.telemetry.watchdog import Watchdog
+from cxxnet_tpu.utils.config import parse_config_string
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MLP_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:sg1] = tanh
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,36
+batch_size = 32
+dev = cpu
+eta = 0.3
+silent = 1
+seed = 7
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def make_trainer():
+    t = NetTrainer()
+    for k, v in parse_config_string(MLP_CFG):
+        t.set_param(k, v)
+    t.init_model()
+    return t
+
+
+def _batch(i, b=32):
+    rng = np.random.RandomState(100 + i)
+    return DataBatch(
+        data=rng.rand(b, 1, 1, 36).astype(np.float32),
+        label=rng.randint(0, 3, size=(b, 1)).astype(np.float32))
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+def test_disabled_recorder_records_nothing():
+    fr = FlightRecorder(size=8)
+    assert fr.start("train", fp="abc") is None
+    fr.finish(None)  # finish(None) is the disarmed no-op
+    assert fr.snapshot() == []
+    assert fr.in_flight() == []
+    assert "no dispatches" in fr.format_tail()
+
+
+def test_record_lifecycle_and_in_flight_marking():
+    fr = FlightRecorder(size=8)
+    fr.arm()
+    fl = fr.start("serve", fp="deadbeef0123", bucket=8, nbytes=1024,
+                  trace="t-1", fields={"rows": 5})
+    (snap,) = fr.snapshot()
+    assert snap["in_flight"] is True
+    assert snap["age_s"] >= 0
+    assert snap["kind"] == "serve" and snap["fp"] == "deadbeef0123"
+    assert snap["bucket"] == 8 and snap["bytes"] == 1024
+    assert snap["trace"] == "t-1" and snap["rows"] == 5
+    assert fr.in_flight()
+    fr.finish(fl)
+    (snap,) = fr.snapshot()
+    assert snap["in_flight"] is False and snap["secs"] >= 0
+    assert fr.in_flight() == []
+    assert "IN-FLIGHT" not in fr.format_tail()
+
+
+def test_ring_wraps_and_keeps_newest():
+    fr = FlightRecorder(size=4)
+    fr.arm()
+    for i in range(10):
+        fr.finish(fr.start("train", fp=f"fp{i}"))
+    snap = fr.snapshot()
+    assert len(snap) == 4
+    assert [s["seq"] for s in snap] == [6, 7, 8, 9]
+    assert fr.tail(2)[-1]["fp"] == "fp9"
+    fr.reset()
+    assert fr.snapshot() == [] and not fr.enabled
+
+
+def test_wedged_in_flight_entry_survives_ring_churn():
+    """The partial-hang case: one replica wedges while the others
+    keep dispatching. The wedged (in-flight) entry must survive ANY
+    amount of ring wrap - it is the one record the recorder exists to
+    keep."""
+    fr = FlightRecorder(size=4)
+    fr.arm()
+    wedged = fr.start("serve", fp="wedged99", bucket=8, trace="t-w")
+    for i in range(20):  # 5x the ring size of later traffic
+        fr.finish(fr.start("serve", fp=f"ok{i}"))
+    (inf,) = fr.in_flight()
+    assert inf["fp"] == "wedged99" and inf["in_flight"] is True
+    # the tail keeps it too (prepended before the bounded window),
+    # so /varz, the watchdog dump and bench forensics all name it
+    tail = fr.tail(4)
+    assert tail[0]["fp"] == "wedged99"
+    assert len(tail) == 5
+    assert "fp=wedged99" in fr.format_tail(4)
+    fr.finish(wedged)
+    assert fr.in_flight() == []
+    # once finished, the long-evicted entry leaves the tail again
+    assert all(t["fp"] != "wedged99" for t in fr.tail(4))
+
+
+def test_open_table_bounded_when_handles_leak():
+    fr = FlightRecorder(size=4)
+    fr.arm()
+    for i in range(10):
+        fr.start("train", fp=f"leak{i}")  # never finished
+    assert len(fr.in_flight()) == 4  # backstop: one ring's worth
+
+
+def test_format_tail_names_in_flight_dispatch():
+    fr = FlightRecorder(size=8)
+    fr.arm()
+    fr.finish(fr.start("train", fp="aaa111"))
+    fr.start("serve", fp="bbb222", bucket=16, trace="t-9")
+    text = fr.format_tail()
+    assert "IN-FLIGHT" in text and "fp=bbb222" in text
+    assert "bucket=16" in text and "trace=t-9" in text
+
+
+def test_fingerprint_stable_and_distinct():
+    a = fingerprint("serve.infer", 3, 8, (1, 1, 36), 0)
+    assert a == fingerprint("serve.infer", 3, 8, (1, 1, 36), 0)
+    assert a != fingerprint("serve.infer", 3, 16, (1, 1, 36), 0)
+    assert len(a) == 12
+
+
+# ---------------------------------------------------------------------------
+# executable registry
+# ---------------------------------------------------------------------------
+def test_registry_register_idempotent_counts_accumulate():
+    reg = ExecutableRegistry()
+    reg.register("fp1", name="train_step@b32", kind="train",
+                 shape="(32, 1, 1, 36)", arg_bytes=4608, donated=1)
+    reg.count_dispatch("fp1", secs=0.5)
+    reg.count_dispatch("fp1")
+    # re-registration must not reset counts; a later compile_s fills in
+    reg.register("fp1", name="other", kind="train", compile_s=1.25)
+    (e,) = reg.snapshot()
+    assert e["name"] == "train_step@b32"  # first registration wins
+    assert e["dispatches"] == 2 and e["dispatch_s"] == 0.5
+    assert e["compile_s"] == 1.25
+    assert e["donated"] == 1 and e["last_used_ts"] is not None
+    reg.count_dispatch("unknown-fp")  # no-op, never raises
+    assert len(reg) == 1
+
+
+def test_registry_enrich_cost_analysis():
+    import jax
+    import jax.numpy as jnp
+    reg = ExecutableRegistry()
+    fn = jax.jit(lambda x: x @ x)
+    x = jnp.ones((8, 8), jnp.float32)
+    reg.register("fpX", name="toy", kind="infer")
+    reg.enrich("fpX", fn, (x,))
+    (e,) = reg.snapshot()
+    assert e["flops"] and e["flops"] > 0
+    assert e["out_bytes"] == 8 * 8 * 4
+    # enriching an unknown fingerprint is a no-op
+    reg.enrich("nope", fn, (x,))
+    assert len(reg) == 1
+
+
+# ---------------------------------------------------------------------------
+# BucketHistogram + exposition grammar for every new series
+# ---------------------------------------------------------------------------
+def test_bucket_histogram_cumulative_snapshot():
+    h = BucketHistogram(bounds=(1, 2, 4))
+    for v in (1, 1, 2, 3, 4, 9):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 6 and snap["sum"] == 20
+    assert snap["buckets"] == {"1": 2, "2": 3, "4": 5, "+Inf": 6}
+    with pytest.raises(ValueError):
+        BucketHistogram(bounds=())
+
+
+def test_bucket_histogram_kind_mismatch_fails_loudly():
+    tel = Telemetry()
+    tel.registry.counter("serve.rows")
+    with pytest.raises(TypeError):
+        tel.registry.bucket_histogram("serve.rows", bounds=(1,))
+    h = tel.registry.bucket_histogram("serve.request_rows",
+                                      bounds=(1, 2))
+    # idempotent: the first creation's bounds win
+    assert tel.registry.bucket_histogram("serve.request_rows",
+                                         bounds=(8, 16)) is h
+
+
+def test_exposition_valid_with_every_new_series():
+    tel = Telemetry()
+    tel.registry.bucket_histogram("serve.request_rows",
+                                  bounds=(1, 2, 4)).observe(3)
+    tel.executables.register(
+        "fp1", name="serve.infer:b8", kind="serve", compile_s=0.5)
+    tel.executables.register("fp2", name="train_step@b32",
+                             kind="train")
+    tel.executables.count_dispatch("fp1")
+    tel.flight.arm()
+    tel.flight.start("serve", fp="fp1", bucket=8)  # stays in flight
+    text = render_prometheus(tel)
+    assert validate_exposition(text) == []
+    assert 'cxxnet_serve_request_rows_bucket{le="+Inf"} 1' in text
+    assert ('cxxnet_executable_dispatches_total{fingerprint="fp1"'
+            in text)
+    assert "cxxnet_executable_compile_seconds" in text
+    assert "cxxnet_flight_inflight 1" in text
+
+
+def test_executables_endpoint_schema_and_varz_flight_tail():
+    tel = Telemetry()
+    tel.flight.arm()
+    tel.executables.register("fpZ", name="serve.infer:b4",
+                             kind="serve", shape="(4, 1, 1, 36)",
+                             arg_bytes=576, donated=0, compile_s=0.1)
+    tel.executables.count_dispatch("fpZ")
+    tel.flight.finish(tel.flight.start("serve", fp="fpZ", bucket=4))
+    tel.flight.start("serve", fp="fpZ", bucket=4)  # in flight
+    srv = ObservabilityServer(tel, 0, host="127.0.0.1").start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        rec = json.loads(_get(base + "/executables"))
+        assert rec["kind"] == "executables"
+        for tag in ("ts", "host", "pid"):
+            assert tag in rec
+        (e,) = rec["executables"]
+        for field in ("fingerprint", "name", "kind", "shape",
+                      "arg_bytes", "device", "donated", "compile_s",
+                      "flops", "cost_bytes", "out_bytes",
+                      "dispatches", "dispatch_s", "last_used_ts"):
+            assert field in e, field
+        assert e["dispatches"] == 1
+        (inf,) = rec["in_flight"]
+        assert inf["fp"] == "fpZ" and inf["in_flight"] is True
+        varz = json.loads(_get(base + "/varz"))
+        assert varz["kind"] == "varz"
+        assert [f["fp"] for f in varz["flight"]] == ["fpZ", "fpZ"]
+    finally:
+        srv.close()
+
+
+def test_varz_omits_flight_when_disarmed():
+    tel = Telemetry()
+    srv = ObservabilityServer(tel, 0, host="127.0.0.1").start()
+    try:
+        # the endpoint itself does not arm the recorder - only
+        # Telemetry.arm_observability does (this server is detached)
+        varz = json.loads(
+            _get(f"http://127.0.0.1:{srv.port}/varz"))
+        assert "flight" not in varz
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# arming contract
+# ---------------------------------------------------------------------------
+def test_flight_arms_with_sinks_and_plane(tmp_path):
+    tel = Telemetry()
+    assert tel.flight.enabled is False
+    tel.configure(log_file=str(tmp_path / "ev.jsonl"))
+    assert tel.flight.enabled is True
+    tel.configure()  # disarm sinks -> recorder follows
+    assert tel.flight.enabled is False
+    tel.arm_observability(watchdog_secs=60.0)
+    assert tel.flight.enabled is True  # the watchdog is a consumer
+    tel.disarm_observability()
+    assert tel.flight.enabled is False
+    tel.flight.arm()  # explicit (flight_recorder=1) survives refresh
+    tel.configure()
+    assert tel.flight.enabled is True
+    tel.close()
+
+
+# ---------------------------------------------------------------------------
+# trainer + serve dispatch sites
+# ---------------------------------------------------------------------------
+def test_trainer_sites_register_and_record():
+    tr = make_trainer()
+    tr.update(_batch(0))
+    tr.update_chunk([_batch(1), _batch(2)])
+    tr.predict(_batch(3))
+    by_name = {e["name"]: e
+               for e in telemetry.executables().snapshot()}
+    assert by_name["train_step@b32"]["dispatches"] == 1
+    assert by_name["train_step@b32"]["donated"] == 1
+    assert by_name["train_chunk@K2b32"]["dispatches"] == 1
+    infer = [e for e in by_name.values() if e["kind"] == "infer"]
+    assert infer and infer[0]["dispatches"] == 1
+    assert infer[0]["donated"] == 0
+    # unarmed: the registry filled but the ring stayed EMPTY
+    assert telemetry.flight().snapshot() == []
+    telemetry.flight().arm()
+    tr.update(_batch(4))
+    tr.predict(_batch(5))
+    kinds = [f["kind"] for f in telemetry.flight().snapshot()]
+    assert kinds == ["train", "infer"]
+    fps = {f["fp"] for f in telemetry.flight().snapshot()}
+    assert fps <= {e["fingerprint"]
+                   for e in telemetry.executables().snapshot()}
+
+
+def test_evaluate_registers_eval_executable():
+    tr = make_trainer()
+
+    class _OneBatch:
+        def __init__(self):
+            self._served = False
+
+        def before_first(self):
+            self._served = False
+
+        def next(self):
+            if self._served:
+                return False
+            self._served = True
+            return True
+
+        def value(self):
+            return _batch(9)
+
+    tr.evaluate(_OneBatch(), "eval")
+    kinds = {e["kind"] for e in telemetry.executables().snapshot()}
+    assert "eval" in kinds
+
+
+def test_trace_id_propagates_through_oversize_split(tmp_path):
+    """One oversize submit (10 rows, max_batch=4 -> 3 parts) must
+    resolve as ONE trace id with a complete part set, each part
+    carrying the queue-vs-device breakdown and ordered stamps."""
+    events = str(tmp_path / "ev.jsonl")
+    telemetry.configure(log_file=events)
+    tr = make_trainer()
+    srv = Server(tr, max_batch=4, max_wait_ms=2.0, replicas=2)
+    srv.warmup()
+    srv.start()
+    fut = srv.submit(np.random.RandomState(0)
+                     .rand(10, 1, 1, 36).astype(np.float32))
+    out = fut.result(timeout=60)
+    assert out.shape[0] == 10
+    stats = srv.stop()
+    telemetry.close()
+    traces = [r for r in read_jsonl(events) if r.get("kind") == "trace"]
+    assert len(traces) == 3
+    assert len({r["trace"] for r in traces}) == 1
+    assert sorted(r["part"] for r in traces) == [0, 1, 2]
+    assert all(r["parts"] == 3 for r in traces)
+    assert sum(r["rows"] for r in traces) == 10
+    for r in traces:
+        assert (r["t_submit"] <= r["t_collect"] <= r["t_dispatch"]
+                <= r["t_done"])
+        assert r["queue_ms"] >= 0 and r["device_ms"] >= 0
+        # the queue/device cut is the dispatch stamp: the coalesce
+        # fill wait is queue time, never device time
+        assert r["queue_ms"] == pytest.approx(
+            (r["t_dispatch"] - r["t_submit"]) * 1e3, abs=0.01)
+        assert r["device_ms"] == pytest.approx(
+            (r["t_done"] - r["t_dispatch"]) * 1e3, abs=0.01)
+        assert r["fp"], "dispatch must name its executable"
+    # the ring recorded the dispatches with the same fingerprints
+    serve_flights = [f for f in telemetry.flight().snapshot()
+                     if f["kind"] == "serve"]
+    assert serve_flights
+    reg_fps = {e["fingerprint"]
+               for e in telemetry.executables().snapshot()
+               if e["kind"] == "serve"}
+    assert {f["fp"] for f in serve_flights} <= reg_fps
+    # stats() exposes the breakdown next to the headline latency
+    assert stats["queue_p50_ms"] is not None
+    assert stats["device_p99_ms"] is not None
+
+
+def test_failed_dispatch_closes_flight_entry_with_error():
+    """A dispatch that RAISES must not read as a hung one: the entry
+    closes carrying the error; only a dispatch that never returns
+    stays in-flight (the hang signature)."""
+    telemetry.flight().arm()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=4, max_wait_ms=1.0, replicas=1)
+    srv.warmup()
+    real = tr.stage_infer_rows
+    state = {"fail": True}
+
+    def flaky(data, extras=()):
+        if state.pop("fail", False):
+            raise RuntimeError("injected staging failure")
+        return real(data, extras)
+
+    tr.stage_infer_rows = flaky
+    srv.start()
+    bad = srv.submit(np.zeros((2, 1, 1, 36), np.float32))
+    with pytest.raises(RuntimeError):
+        bad.result(timeout=60)
+    good = srv.submit(np.zeros((2, 1, 1, 36), np.float32))
+    good.result(timeout=60)
+    srv.stop()
+    serve_flights = [f for f in telemetry.flight().snapshot()
+                     if f["kind"] == "serve"]
+    assert len(serve_flights) == 2
+    failed, ok = serve_flights
+    assert failed["in_flight"] is False
+    assert "injected staging failure" in failed["error"]
+    assert ok["in_flight"] is False and "error" not in ok
+    assert telemetry.flight().in_flight() == []
+
+
+def test_programmatic_metrics_server_arms_flight():
+    """Server(trainer, metrics_port=...) - the programmatic twin of
+    the CLI key - must arm the recorder too: the endpoint it attaches
+    serves /varz and /executables, and warmup's cost enrichment runs
+    before start(). stop() re-derives (nothing else armed -> off)."""
+    tr = make_trainer()
+    srv = Server(tr, max_batch=4, max_wait_ms=1.0, replicas=1,
+                 metrics_port=0, metrics_host="127.0.0.1")
+    assert telemetry.flight().enabled  # armed at construction
+    srv.warmup()
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.metrics_server.port}"
+        srv.submit(np.zeros((3, 1, 1, 36), np.float32)
+                   ).result(timeout=60)
+        varz = json.loads(_get(base + "/varz"))
+        assert any(f["kind"] == "serve" for f in varz["flight"])
+        execs = json.loads(_get(base + "/executables"))
+        serve_entries = [e for e in execs["executables"]
+                         if e["kind"] == "serve"]
+        assert serve_entries
+        # armed-at-warmup: the cost enrichment ran
+        assert all(e["flops"] is not None for e in serve_entries)
+    finally:
+        srv.stop()
+    assert telemetry.flight().enabled is False
+
+
+def test_request_rows_histogram_reaches_metrics(tmp_path):
+    telemetry.configure(log_file=str(tmp_path / "ev.jsonl"))
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1)
+    srv.warmup()
+    srv.start()
+    for n in (1, 3, 8, 8):
+        srv.submit(np.random.RandomState(n)
+                   .rand(n, 1, 1, 36).astype(np.float32)
+                   ).result(timeout=60)
+    srv.stop()
+    text = render_prometheus(telemetry.get())
+    assert validate_exposition(text) == []
+    assert 'cxxnet_serve_request_rows_bucket{le="8"} 4' in text
+    assert "cxxnet_serve_request_rows_count 4" in text
+    telemetry.close()
+
+
+# ---------------------------------------------------------------------------
+# watchdog stall dump carries the flight tail (one dump per episode)
+# ---------------------------------------------------------------------------
+def test_watchdog_dump_names_in_flight_executable(tmp_path, capfd):
+    tel = Telemetry()
+    log = str(tmp_path / "ev.jsonl")
+    tel.configure(log_file=log)
+    tel.flight.finish(tel.flight.start("train", fp="aaa111",
+                                       bucket=32))
+    tel.flight.start("serve", fp="bbb222", bucket=8, trace="t-42")
+    now = time.monotonic()
+    wd = Watchdog(tel, 5.0)
+    wd._armed_at = now
+    tel.beacon("train.step")
+    base = time.monotonic()
+    assert wd.check_now(base + 6) is True    # stalled: one dump
+    assert wd.check_now(base + 7) is True    # same episode: no second
+    tel.close()
+    err = capfd.readouterr().err
+    assert "flight recorder" in err
+    assert "IN-FLIGHT" in err and "fp=bbb222" in err
+    assert "trace=t-42" in err
+    assert err.count("flight recorder") == 1  # one dump per episode
+    dumps = [e for e in read_jsonl(log)
+             if e.get("kind") == "watchdog"
+             and e.get("op") == "stall_dump"]
+    assert len(dumps) == 1
+    flights = dumps[0]["flights"]
+    assert [f["fp"] for f in flights] == ["aaa111", "bbb222"]
+    assert flights[-1]["in_flight"] is True
+
+
+# ---------------------------------------------------------------------------
+# trace export: Chrome trace-event JSON span trees
+# ---------------------------------------------------------------------------
+# synthetic records use a fixed wall-monotonic offset of 990 s (the
+# record-level `ts` is wall time stamped at emission ~= t_done)
+_WALL_OFF = 990.0
+
+
+def _trace_rec(trace, part, parts, t0, tc, t1, bucket=8, rows=4,
+               pid=7):
+    return {"kind": "trace", "pid": pid, "trace": trace, "part": part,
+            "parts": parts, "rows": rows, "bucket": bucket,
+            "fp": "fp1", "t_submit": t0, "t_collect": tc,
+            "t_done": t1, "ts": t1 + _WALL_OFF,
+            "queue_ms": (tc - t0) * 1e3,
+            "device_ms": (t1 - tc) * 1e3}
+
+
+def test_trace_export_complete_span_trees(tmp_path):
+    from cxxnet_tpu.tools import trace_export
+    events = tmp_path / "ev.jsonl"
+    recs = [
+        _trace_rec("r-1", 0, 1, 10.0, 10.01, 10.02),
+        _trace_rec("r-2", 0, 2, 10.005, 10.02, 10.03),
+        _trace_rec("r-2", 1, 2, 10.005, 10.03, 10.04),
+        # incomplete request: part 1 of 2 never resolved
+        _trace_rec("r-3", 0, 2, 10.05, 10.06, 10.07),
+        # stall dump at wall 10.035+990: must land BETWEEN r-2's
+        # resolution (mono 10.04) and r-3 (mono 10.05) on the SHARED
+        # timeline, not shifted by the wall/monotonic epoch gap
+        {"kind": "watchdog", "op": "stall_dump", "pid": 7,
+         "ts": 10.035 + _WALL_OFF, "stalled_secs": 9.0},
+    ]
+    with open(events, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    out = tmp_path / "trace.json"
+    summary = trace_export.export(str(events), str(out),
+                                  str(tmp_path / "summary.json"))
+    assert summary["parts"] == 4
+    assert summary["requests"] == 3
+    assert summary["complete_requests"] == 2  # r-3 is incomplete
+    assert summary["queue_p99_ms"] is not None
+    assert summary["dispatches_by_bucket"] == {"8": 4}
+    trace = json.loads(out.read_text())
+    ev = trace["traceEvents"]
+    spans = [e for e in ev if e["ph"] == "X"]
+    # request + queue + device per part
+    assert len(spans) == 3 * 4
+    names = {e["name"] for e in spans}
+    assert "queue" in names and "device" in names
+    assert any(n.startswith("request r-2 [2/2]") for n in names)
+    # spans carry microsecond ts/dur and the split args
+    req = [e for e in spans if e["name"].startswith("request r-1")][0]
+    assert req["dur"] == pytest.approx(0.02 * 1e6, rel=1e-3)
+    assert req["args"]["trace"] == "r-1"
+    # concurrent r-1/r-2 got distinct lanes; the marker rendered ON
+    # the request timeline (wall ts re-anchored via the per-record
+    # wall/monotonic pair): mono 10.035 - base 10.0 = 35 ms
+    assert len({e["tid"] for e in spans}) >= 2
+    (marker,) = [e for e in ev if e["ph"] == "i"
+                 and "stall_dump" in e["name"]]
+    assert marker["ts"] == pytest.approx(0.035 * 1e6, rel=1e-3)
+    assert (tmp_path / "summary.json").exists()
+
+
+def test_trace_export_cli_empty_stream(tmp_path):
+    from cxxnet_tpu.tools import trace_export
+    events = tmp_path / "empty.jsonl"
+    events.write_text("")
+    rc = trace_export.main([str(events), "-o",
+                            str(tmp_path / "t.json")])
+    assert rc == 1  # nothing to export is a loud condition
+
+
+# ---------------------------------------------------------------------------
+# config schema + the unarmed byte-parity contract
+# ---------------------------------------------------------------------------
+def test_schema_recognizes_flight_recorder_key():
+    from cxxnet_tpu.analysis.schema import validate_pairs
+    from cxxnet_tpu.utils.config import ConfigError
+    validate_pairs([("flight_recorder", "1")], source="x.conf")
+    with pytest.raises(ConfigError) as ei:
+        validate_pairs([("flight_recorderr", "1")], source="x.conf")
+    assert "flight_recorder" in str(ei.value)
+
+
+CLI_CONF = """
+data = train
+iter = mnist
+    path_img = "{d}/train-img.gz"
+    path_label = "{d}/train-lbl.gz"
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,36
+batch_size = 32
+dev = cpu
+save_model = 0
+num_round = 1
+max_round = 1
+eta = 0.3
+metric = error
+silent = 0
+"""
+
+
+def test_cli_byte_parity_with_flight_armed(tmp_path):
+    """tracing off = zero behavior change, and an ARMED ring with no
+    sink writes nothing either: stdout+stderr of a plain run and a
+    flight_recorder=1 run must be byte-identical (the in-memory ring
+    is invisible at the product surface)."""
+    from cxxnet_tpu.tools.telemetry_smoke import write_synth_mnist
+    d = str(tmp_path)
+    write_synth_mnist(d, 64, 0, "train")
+    conf = os.path.join(d, "parity.conf")
+    with open(conf, "w") as f:
+        f.write(CLI_CONF.format(d=d))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "cxxnet_tpu.main", conf,
+             f"model_dir={d}/models"] + list(extra),
+            capture_output=True, timeout=300, env=env, cwd=REPO)
+
+    plain = run()
+    armed = run("flight_recorder=1")
+    assert plain.returncode == armed.returncode == 0
+    assert plain.stdout == armed.stdout
+    assert plain.stderr == armed.stderr

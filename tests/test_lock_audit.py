@@ -247,7 +247,8 @@ def test_unknown_scenario_rejected():
     with pytest.raises(ValueError, match="no-such-scenario"):
         run_lock_audit(scenarios=("no-such-scenario",))
     assert set(SCENARIOS) == {
-        "prefetch-round", "watchdog-stall", "serve-storm"}
+        "prefetch-round", "watchdog-stall", "serve-storm",
+        "elastic-coordinator"}
 
 
 # ---------------------------------------------------------------------------
