@@ -1012,6 +1012,100 @@ def _bench_serve(ctx) -> dict:
         return {"serve_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_serve_storm(ctx) -> dict:
+    """Overload behavior of the serving front (docs/SERVING.md
+    "Serving over HTTP"): an OPEN-LOOP Poisson load generator - seeded
+    exponential inter-arrivals, ragged request sizes - driven at ~2x
+    the server's measured sustainable row rate with `queue_limit`
+    armed, so the excess MUST be shed rather than queued. The numbers
+    that matter under overload: `serve_storm_p99_ms` is the end-to-end
+    p99 of the ACCEPTED requests (bounded latency is the whole point
+    of shedding - an unbounded queue would show every request slow),
+    and `serve_shed_frac` is the shed fraction of offered requests
+    (~0.5 at 2x is healthy; ~0 means the storm never exceeded
+    capacity, ~1 means admission collapsed). Open-loop matters:
+    a closed-loop generator self-throttles when the server slows,
+    hiding exactly the overload this measures. Disable with
+    CXN_BENCH_SERVE_STORM=0."""
+    if os.environ.get("CXN_BENCH_SERVE_STORM") == "0":
+        return {}
+    try:
+        from cxxnet_tpu.serve import QueueFullError, Server
+        tr = ctx.trainer
+        batch = ctx.batch
+        rng = np.random.RandomState(23)
+        data, _ = _alexnet_batch(rng, batch)
+        mb = min(batch,
+                 int(os.environ.get("CXN_BENCH_SERVE_MAXB", "32")))
+        # leg 1: closed-loop calibration of the sustainable row rate
+        # over the same buckets (no limit, no storm)
+        srv = Server(tr, max_batch=mb, max_wait_ms=2.0, replicas=2)
+        srv.warmup()
+        srv.start()
+        cycle = [1, mb // 2, mb, 3, mb // 4 or 1, 7]
+        cal_sizes = [max(1, min(s, mb)) for s in cycle * 6]
+        t0 = time.perf_counter()
+        futs = [srv.submit(data[:n]) for n in cal_sizes]
+        for f in futs:
+            f.result(timeout=600)
+        cal_dt = max(time.perf_counter() - t0, 1e-9)
+        cal_stats = srv.stop()
+        sustainable_rows = sum(cal_sizes) / cal_dt
+        # leg 2: the storm - offered load 2x sustainable, hard
+        # queue_limit of ~4 buckets of backlog
+        limit = 4 * mb
+        srv = Server(tr, max_batch=mb, max_wait_ms=2.0, replicas=2,
+                     queue_limit=limit)
+        srv.warmup()
+        srv.start()
+        offered_rows = 2.0 * sustainable_rows
+        mean_size = sum(cal_sizes) / len(cal_sizes)
+        n_req = max(60, int(os.environ.get(
+            "CXN_BENCH_STORM_REQS", "120")))
+        gaps = rng.exponential(mean_size / offered_rows, n_req)
+        sizes = [max(1, min(int(rng.choice(cycle)), mb))
+                 for _ in range(n_req)]
+        arrivals = np.cumsum(gaps)
+        live, shed = [], 0
+        t_start = time.perf_counter()
+        for i in range(n_req):
+            # open loop: sleep the Poisson gap regardless of how the
+            # server is doing, then offer the request
+            target = t_start + float(arrivals[i])
+            pause = target - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+            t_sub = time.perf_counter()
+            try:
+                live.append((srv.submit(data[:sizes[i]]), t_sub))
+            except QueueFullError:
+                shed += 1
+        lat_ms = []
+        for f, t_sub in live:
+            f.result(timeout=600)
+            lat_ms.append((time.perf_counter() - t_sub) * 1e3)
+        stats = srv.stop()
+        if stats["errors"]:
+            return {"serve_storm_error":
+                    f"{stats['errors']} dispatch errors"}
+        lat_ms.sort()
+        p99 = lat_ms[min(len(lat_ms) - 1,
+                         int(0.99 * len(lat_ms)))] if lat_ms else 0.0
+        return {
+            "serve_storm_p99_ms": round(p99, 2),
+            "serve_shed_frac": round(shed / max(n_req, 1), 4),
+            "serve_storm_accepted": len(live),
+            "serve_storm_offered": n_req,
+            "serve_storm_offered_rows_per_s": round(offered_rows, 2),
+            "serve_storm_sustainable_rows_per_s": round(
+                sustainable_rows, 2),
+            "serve_storm_queue_limit": limit,
+            "serve_uncontended_p99_ms": cal_stats["latency_p99_ms"],
+        }
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"serve_storm_error": f"{type(e).__name__}: {e}"}
+
+
 _BN_CONVNET_CONF = """
 netconfig=start
 layer[+1:c1] = conv:c1
@@ -1520,6 +1614,8 @@ _MEASUREMENTS = (
     ("fused", _bench_fused, "CXN_BENCH_FUSED", 150, "h2d"),
     ("zero", _bench_zero, "CXN_BENCH_ZERO", 150, "h2d"),
     ("serve", _bench_serve, "CXN_BENCH_SERVE", 150, "h2d"),
+    ("serve_storm", _bench_serve_storm, "CXN_BENCH_SERVE_STORM", 150,
+     "h2d"),
     ("fold", _bench_fold, "CXN_BENCH_FOLD", 150, "h2d"),
     ("int8", _bench_int8, "CXN_BENCH_INT8", 150, "h2d"),
     ("autotune", _bench_autotune, "CXN_BENCH_AUTOTUNE", 150, "h2d"),
@@ -1981,6 +2077,10 @@ _SYNC_SOURCE = {
     "zero2_ips": "zero",
     "serve_qps": "serve", "serve_rows_per_s": "serve",
     "serve_over_predict": "serve",
+    # overload numbers, NOT throughput maxima: p99 under storm and
+    # shed fraction have no "last-good max" semantics
+    "serve_storm_p99_ms": "serve_storm",
+    "serve_shed_frac": "serve_storm",
     "fold_infer_ips": "fold", "fold_unfolded_ips": "fold",
     "fold_over_infer": "fold",
     "int8_infer_ips": "int8", "int8_fold_ips": "int8",

@@ -32,7 +32,9 @@ The audited paths are the real exercised ones, reusing the smoke
 harnesses' shapes (docs/STATIC_ANALYSIS.md "Concurrency analysis"):
 
 - ``serve-storm``: a live continuous-batching ``Server`` (2 replicas,
-  warmed buckets) under a ragged multi-thread request storm;
+  warmed buckets, HTTP ingress + queue-limit admission + checkpoint
+  watcher armed) under a ragged multi-thread request storm with a
+  mid-storm /predict POST and a live checkpoint hot-swap;
 - ``prefetch-round``: a ``StagedPrefetcher`` pass (chunked, plus a
   mid-stream close) - the io producer/consumer queue discipline;
 - ``watchdog-stall``: a fresh telemetry instance with heartbeat +
@@ -586,15 +588,33 @@ def _scenario_serve_storm(aud: LockAuditor,
                           trainer) -> List[Dict[str, Any]]:
     """A live continuous-batching Server under a ragged request storm
     from 3 submitter threads (splits, coalescing, padding, replica
-    fan-out all exercised); every future must resolve."""
+    fan-out all exercised); every future must resolve. The production
+    front rides along: the HTTP ingress thread answers a /predict
+    POST mid-storm, the admission check runs with a (non-binding)
+    queue_limit armed, and the checkpoint watcher thread picks up a
+    published checkpoint and hot-swaps it live - so the new
+    ingress/shed/swap lock interactions land in the audited graph."""
+    import json as _json
+    import tempfile
+    import urllib.request
+
     import numpy as np
 
+    from cxxnet_tpu.nnet import checkpoint as _ckpt
     from cxxnet_tpu.serve.server import Server
 
-    srv = Server(trainer, max_batch=8, max_wait_ms=2.0, replicas=2)
+    tmpd = tempfile.mkdtemp(prefix="lock_audit_serve_")
+    saved = os.path.join(tmpd, "0001.model")
+    with open(saved, "wb") as f:
+        trainer.save_model(f)
+    watch = os.path.join(tmpd, "publish.model")
+    srv = Server(trainer, max_batch=8, max_wait_ms=2.0, replicas=2,
+                 http_port=0, queue_limit=100000,
+                 swap_watch=watch, swap_poll_ms=20.0)
     rows_sent = 0
     errors: List[str] = []
     results: List[int] = []
+    http_status = 0
     res_lock = threading.Lock()
     srv.warmup()
     with srv:
@@ -621,8 +641,28 @@ def _scenario_serve_storm(aud: LockAuditor,
                    for s in (11, 22, 33)]
         for t in threads:
             t.start()
+        # mid-storm: one /predict POST through the ingress thread and
+        # one checkpoint published to the watched path (same weights -
+        # the full validate/stage/flip path is what the audit wants)
+        body = _json.dumps({"data": [[0.1] * 36]}).encode()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.metrics_server.port}/predict",
+                data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                http_status = r.status
+        except Exception as e:  # noqa: BLE001 - reported below
+            with res_lock:
+                errors.append(f"http: {type(e).__name__}: {e}")
+        _ckpt.publish_model(saved, watch)
         for t in threads:
             t.join(timeout=120.0)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if srv.stats()["swaps"] >= 1:
+                break
+            time.sleep(0.02)
         alive = [t.name for t in threads if t.is_alive()]
         rows_sent = 3 * sum(_STORM_SIZES)
     stats = srv.stats()
@@ -637,6 +677,12 @@ def _scenario_serve_storm(aud: LockAuditor,
                stats["batches"] > 0 and stats["errors"] == 0,
                f"{stats['batches']} batches, "
                f"{stats['errors']} errors"),
+        _check("serve-storm", "http-ingress-answered",
+               http_status == 200, f"status {http_status}"),
+        _check("serve-storm", "checkpoint-hot-swapped",
+               stats["swaps"] == 1 and stats["swap_rejected"] == 0,
+               f"{stats['swaps']} swaps, "
+               f"{stats['swap_rejected']} rejected"),
     ]
     return checks
 

@@ -79,6 +79,11 @@ class LearnTask:
         # checkpoint rotation: keep the newest k %04d.model files
         # (0 = keep everything, the reference behavior)
         self.keep_latest = 0
+        # serving publish hook (docs/SERVING.md "Hot-swap runbook"):
+        # after every saved round, atomically copy the checkpoint to
+        # this path - the file a live Server's swap_watch= poller
+        # picks up for a zero-downtime weight swap ("" = off)
+        self.name_publish = ""
         self.name_model_in = "NULL"
         self.name_pred = "pred.txt"
         self.print_step = 100
@@ -271,6 +276,8 @@ class LearnTask:
             self.save_period = int(val)
         if name == "keep_latest":
             self.keep_latest = int(val)
+        if name == "publish_model":
+            self.name_publish = val
         if name == "start_counter":
             self.start_counter = int(val)
         if name == "model_in":
@@ -725,6 +732,12 @@ class LearnTask:
             self._coordinator.publish(barrier, counter, path,
                                       file_sha256(path), nbytes)
         self._rotate_models(counter)
+        if self.name_publish:
+            # serving publish hook: atomic copy to the swap_watch'd
+            # path AFTER the round file is durable - a live Server
+            # sees complete checkpoints appear, never partial ones
+            from cxxnet_tpu.nnet import checkpoint
+            checkpoint.publish_model(path, self.name_publish)
 
     def _pod_barrier(self, counter: int):
         """One coordinated checkpoint barrier; a conviction exits this
@@ -1094,7 +1107,8 @@ class LearnTask:
         assert self.itr_pred is not None, \
             "must specify a predict iterator to drive task = serve"
         import numpy as np
-        from cxxnet_tpu.serve import Server, predictions_from_rows
+        from cxxnet_tpu.serve import (
+            QueueFullError, Server, predictions_from_rows)
         if (not self._calibrate_passes()
                 and self.net_trainer.passes_need_calibration()):
             # fold_conv_bn needs statistics BEFORE the bucket
@@ -1153,9 +1167,19 @@ class LearnTask:
                     lo = 0
                     while lo < valid:
                         n = min(next(sizes), valid - lo)
-                        futures.append(srv.submit(
-                            data[lo:lo + n],
-                            [e[lo:lo + n] for e in extras]))
+                        try:
+                            futures.append(srv.submit(
+                                data[lo:lo + n],
+                                [e[lo:lo + n] for e in extras]))
+                        except QueueFullError as e:
+                            # serve_queue_limit armed below the
+                            # in-flight window: this driver is the
+                            # well-behaved client - honor the advice,
+                            # drain, resubmit (no row may drop; the
+                            # output must stay line-for-line pred)
+                            drain(max_inflight // 2)
+                            time.sleep(min(e.retry_after_s, 0.5))
+                            continue
                         lo += n
                         drain(max_inflight)
                 drain(0)

@@ -329,3 +329,33 @@ def validate_file(path: str) -> Optional[str]:
         return None
     except (OSError, TypeError, ValueError, KeyError, struct.error) as e:
         return str(e)
+
+
+def publish_model(src_path: str, publish_path: str) -> None:
+    """Publish a saved checkpoint to a serving-watched path
+    (docs/SERVING.md "Hot-swap runbook"): a streaming atomic copy
+    (tmp + fsync + os.replace), so a live Server's `swap_watch`
+    poller only ever observes a complete file appear - never a
+    half-written one. The `swap_torn_checkpoint` fault point
+    ("corrupt") publishes a deliberately truncated, trailer-less copy
+    instead, driving the swap-reject path in tests and the
+    serve-http-smoke torn-checkpoint leg."""
+    t0 = time.perf_counter()
+    torn = fault.fault_point("swap_torn_checkpoint") == "corrupt"
+    size = os.path.getsize(src_path)
+    copied = 0
+    # a torn publish keeps roughly half the payload and drops the
+    # rest (incl. the crc trailer): the shape a non-atomic writer
+    # killed mid-copy would have left behind
+    budget = max(1, size // 2) if torn else size
+    with open(src_path, "rb") as fi, \
+            fault.atomic_writer(publish_path) as fo:
+        while copied < budget:
+            buf = fi.read(min(1 << 20, budget - copied))
+            if not buf:
+                break
+            fo.write(buf)
+            copied += len(buf)
+    telemetry.event("checkpoint", op="publish", src=src_path,
+                    path=publish_path, bytes=copied, torn=torn,
+                    secs=round(time.perf_counter() - t0, 4))

@@ -184,6 +184,24 @@ class NetTrainer:
         self.serve_max_batch = 0
         self.serve_max_wait_ms = 2.0
         self.serve_replicas = 1
+        # serving production front (docs/SERVING.md "Serving over
+        # HTTP"): serve_port arms the /predict HTTP request path on
+        # the attached exposition listener (0 = off, in-process
+        # submit only); serve_queue_limit is the hard admission bound
+        # in rows (0 = unlimited - submits past it shed with 429 /
+        # QueueFullError); serve_deadline_ms the default per-request
+        # deadline (0 = none, expired requests drop before dispatch);
+        # serve_shed_clear_ms the shed->healthy /healthz hysteresis
+        self.serve_port = 0
+        self.serve_queue_limit = 0
+        self.serve_deadline_ms = 0.0
+        self.serve_shed_clear_ms = 1000.0
+        # zero-downtime checkpoint hot-swap (docs/SERVING.md "Hot-swap
+        # runbook"): a live Server polls swap_watch every swap_poll_ms
+        # and swaps weights from any newly published (atomic,
+        # checksummed) checkpoint; "" = off
+        self.swap_watch = ""
+        self.swap_poll_ms = 200.0
         # explicit serving bucket ladder (serve_bucket_ladder = comma
         # ints; None = power-of-two default): Server(trainer) reads
         # it; a tuning-cache serve_ladder fills it as a default under
@@ -328,6 +346,28 @@ class NetTrainer:
             if int(val) < 1:
                 raise ValueError("serve_replicas must be >= 1")
             self.serve_replicas = int(val)
+        if name == "serve_port":
+            if int(val) < 0 or int(val) > 65535:
+                raise ValueError("serve_port must be in [0, 65535]")
+            self.serve_port = int(val)
+        if name == "serve_queue_limit":
+            if int(val) < 0:
+                raise ValueError("serve_queue_limit must be >= 0")
+            self.serve_queue_limit = int(val)
+        if name == "serve_deadline_ms":
+            if float(val) < 0:
+                raise ValueError("serve_deadline_ms must be >= 0")
+            self.serve_deadline_ms = float(val)
+        if name == "serve_shed_clear_ms":
+            if float(val) < 0:
+                raise ValueError("serve_shed_clear_ms must be >= 0")
+            self.serve_shed_clear_ms = float(val)
+        if name == "swap_watch":
+            self.swap_watch = val
+        if name == "swap_poll_ms":
+            if float(val) <= 0:
+                raise ValueError("swap_poll_ms must be > 0")
+            self.swap_poll_ms = float(val)
         if name == "serve_bucket_ladder":
             rungs = [int(t) for t in val.split(",") if t.strip()]
             if (not rungs or any(r < 1 for r in rungs)

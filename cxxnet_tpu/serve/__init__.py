@@ -1,8 +1,9 @@
 """Continuous-batching inference serving layer (docs/SERVING.md)."""
 
 from cxxnet_tpu.serve.server import (
-    Server, bucket_sizes, ladder_buckets, ladder_from_histogram,
-    predictions_from_rows)
+    DeadlineExpiredError, QueueFullError, Server, bucket_sizes,
+    ladder_buckets, ladder_from_histogram, predictions_from_rows)
 
 __all__ = ["Server", "bucket_sizes", "ladder_buckets",
-           "ladder_from_histogram", "predictions_from_rows"]
+           "ladder_from_histogram", "predictions_from_rows",
+           "QueueFullError", "DeadlineExpiredError"]

@@ -46,6 +46,33 @@ fingerprint + bucket + trace id - telemetry/flight.py), each warmed
 bucket registers on `/executables`, and resolved requests emit `trace`
 events that `tools/trace_export.py` renders to Perfetto-loadable
 Chrome trace JSON.
+
+The production front (this PR's layer, docs/SERVING.md "Serving over
+HTTP" + "Hot-swap runbook"):
+
+- **HTTP request path**: `Server(http_port=N)` (CLI `serve_port=`)
+  attaches a `/predict` POST endpoint to the same stdlib listener
+  that serves `/metrics`/`/healthz` - rows in, predictions out, trace
+  ids minted at ingress so the queue-vs-device decomposition covers
+  the network hop;
+- **backpressure + load shedding**: a hard `queue_limit` (rows) above
+  which `submit()` raises a typed `QueueFullError` and `/predict`
+  returns 429 with a `Retry-After` derived from the queue depth and
+  the measured drain rate; shedding flips `/healthz` to 503 through
+  the health source map (`serve_shed`) until the queue drains below
+  half the limit for a hysteresis window, so an LB can rotate the
+  replica out and back in;
+- **per-request deadlines**: `deadline_ms` (server default or per
+  request) expires queued requests BEFORE dispatch - a dead request
+  never wastes a bucket slot - surfacing as `DeadlineExpiredError`
+  in-process and 504 over HTTP;
+- **zero-downtime hot-swap**: `swap_to(path)` (or the `swap_watch=`
+  polling thread) validates an atomic checksummed checkpoint (crc32
+  trailer), stages the new params to device OUTSIDE any lock, and
+  switches between batches under `_swap_lock`; in-flight dispatches
+  already bound the old params and finish on the old weights, no
+  request drops. A torn/corrupt file is rejected (`swap.rejected`
+  event) and the old weights keep serving.
 """
 
 from __future__ import annotations
@@ -61,6 +88,26 @@ import numpy as np
 
 from cxxnet_tpu import telemetry
 from cxxnet_tpu.telemetry.flight import fingerprint as exec_fingerprint
+from cxxnet_tpu.utils import fault
+
+
+class QueueFullError(RuntimeError):
+    """submit() rejected: the queue is at `queue_limit` rows (load
+    shedding, docs/SERVING.md). Carries the advice an HTTP 429 turns
+    into a Retry-After header: `retry_after_s` (queue depth over the
+    measured drain rate) and the `queue_depth` at rejection."""
+
+    def __init__(self, msg: str, retry_after_s: float,
+                 queue_depth: int) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.queue_depth = queue_depth
+
+
+class DeadlineExpiredError(RuntimeError):
+    """The request's deadline passed while it was still queued; it was
+    dropped before dispatch (a dead request must never spend a bucket
+    slot). HTTP callers see 504."""
 
 
 def bucket_sizes(max_batch: int, data_axis: int = 1) -> Tuple[int, ...]:
@@ -154,12 +201,15 @@ class _Future:
     """Minimal one-shot result future (no concurrent.futures executor
     to tie its lifetime to)."""
 
-    __slots__ = ("_ev", "_value", "_error")
+    __slots__ = ("_ev", "_value", "_error", "trace")
 
     def __init__(self) -> None:
         self._ev = threading.Event()
         self._value = None
         self._error: Optional[BaseException] = None
+        # the request trace id (minted at submit; the HTTP front
+        # echoes it in the /predict response body)
+        self.trace = ""
 
     def _set(self, value) -> None:
         self._value = value
@@ -189,6 +239,10 @@ class _JoinedFuture:
     def __init__(self, parts: List[_Future]) -> None:
         self._parts = parts
 
+    @property
+    def trace(self) -> str:
+        return self._parts[0].trace if self._parts else ""
+
     def done(self) -> bool:
         return all(p.done() for p in self._parts)
 
@@ -205,15 +259,18 @@ class _JoinedFuture:
 
 class _WorkItem:
     __slots__ = ("data", "extras", "n", "t_submit", "future",
-                 "trace", "part", "nparts", "t_collect")
+                 "trace", "part", "nparts", "t_collect", "deadline")
 
     def __init__(self, data, extras, t_submit, trace="",
-                 part=0, nparts=1) -> None:
+                 part=0, nparts=1, deadline=0.0) -> None:
         self.data = data
         self.extras = extras
         self.n = data.shape[0]
         self.t_submit = t_submit
         self.future = _Future()
+        # absolute monotonic expiry (0 = none): checked at queue-pop
+        # so an expired request drops BEFORE dispatch
+        self.deadline = deadline
         # end-to-end request tracing (docs/OBSERVABILITY.md "Request
         # tracing"): the trace id minted at submit(), the part index
         # for oversize requests that split, and the coalesce time a
@@ -244,7 +301,12 @@ class Server:
                  node: int = -1,
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "0.0.0.0",
-                 ladder: Optional[Sequence[int]] = None) -> None:
+                 ladder: Optional[Sequence[int]] = None,
+                 http_port: Optional[int] = None,
+                 queue_limit: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 swap_watch: Optional[str] = None,
+                 swap_poll_ms: Optional[float] = None) -> None:
         import jax
         if trainer.state is None:
             raise RuntimeError(
@@ -302,11 +364,26 @@ class Server:
         # metrics_port=N serves /metrics + /healthz + /varz for the
         # Server's lifetime (0 = ephemeral bind, read .metrics_server
         # .port). None = off; programmatic twins of the CLI key, which
-        # arms the process-wide plane in main.run instead
-        self.metrics_port = metrics_port
+        # arms the process-wide plane in main.run instead.
+        # http_port=N (CLI serve_port=) attaches the SAME listener
+        # plus the /predict request path - one socket, both surfaces;
+        # specifying both ports with different values is an error.
+        if http_port is None:
+            cfg_port = int(getattr(trainer, "serve_port", 0) or 0)
+            if cfg_port > 0:
+                http_port = cfg_port
+        if (http_port is not None and metrics_port is not None
+                and int(http_port) != int(metrics_port)):
+            raise ValueError(
+                "serve_port and metrics_port attach ONE listener; "
+                f"set them equal or drop one (got {http_port} vs "
+                f"{metrics_port})")
+        self.http_port = http_port
+        self.metrics_port = (metrics_port if metrics_port is not None
+                             else http_port)
         self.metrics_host = metrics_host
         self.metrics_server = None
-        if metrics_port is not None:
+        if self.metrics_port is not None:
             # the attached exposition endpoint is a flight-recorder
             # consumer (it serves the /varz tail and /executables) -
             # arm the recorder for this Server's lifetime, the same
@@ -327,6 +404,42 @@ class Server:
         self._draining = False
         self._started = False
         self.warmup_s = 0.0
+        # backpressure (docs/SERVING.md "Serving over HTTP"): hard
+        # queue bound in ROWS (0 = unlimited), the default request
+        # deadline, and the shed->healthy hysteresis window
+        self.queue_limit = int(
+            trainer.serve_queue_limit if queue_limit is None
+            else queue_limit)
+        self.deadline_ms = float(
+            trainer.serve_deadline_ms if deadline_ms is None
+            else deadline_ms)
+        self.shed_clear_ms = float(
+            getattr(trainer, "serve_shed_clear_ms", 1000.0))
+        # guarded-by: self._cond
+        self._last_shed_t = 0.0
+        # whether this Server currently holds the `serve_shed` source
+        # unhealthy (503 on /healthz); cleared with hysteresis once
+        # the queue drains below queue_limit/2 for shed_clear_ms
+        # guarded-by: self._cond
+        self._shed_health = False
+        # checkpoint hot-swap (docs/SERVING.md "Hot-swap runbook"):
+        # _swap_lock orders the params/fn switch against dispatch
+        # snapshots; ONLY attribute reads/writes happen under it -
+        # staging (device_put) and warmup stay outside (GL015)
+        self._swap_lock = threading.Lock()
+        self.swap_watch = (swap_watch if swap_watch is not None
+                           else getattr(trainer, "swap_watch", "")) or ""
+        self.swap_poll_ms = float(
+            getattr(trainer, "swap_poll_ms", 200.0)
+            if swap_poll_ms is None else swap_poll_ms)
+        self._swap_thread: Optional[threading.Thread] = None
+        # watcher shutdown signal (checked each poll tick)
+        self._swap_stop = threading.Event()
+        # last (mtime_ns, size) the watcher acted on - recorded even
+        # for a REJECTED file so a torn checkpoint is skipped once,
+        # not re-validated in a hot loop
+        # guarded-by: self._swap_lock
+        self._swap_seen: Optional[Tuple[int, int]] = None
         # product-surface accounting, independent of the process-wide
         # registry (a second Server in one process must not inherit
         # the first one's counts OR its latency window); the registry
@@ -342,6 +455,22 @@ class Server:
         self._n_padding = 0
         # guarded-by: self._lock
         self._n_errors = 0
+        # guarded-by: self._lock
+        self._n_shed = 0
+        # guarded-by: self._lock
+        self._n_shed_rows = 0
+        # guarded-by: self._lock
+        self._n_expired = 0
+        # guarded-by: self._lock
+        self._n_swaps = 0
+        # guarded-by: self._lock
+        self._n_swap_rejected = 0
+        # measured drain rate (rows/s, EWMA over dispatched batches):
+        # what Retry-After is derived from
+        # guarded-by: self._lock
+        self._drain_rate = 0.0
+        # guarded-by: self._lock
+        self._last_drain_t = 0.0
         # guarded-by: self._lock
         self._bucket_hits: Dict[int, int] = {b: 0 for b in self.buckets}
         # request-size histogram: the serve telemetry the autotuner's
@@ -427,11 +556,14 @@ class Server:
             from cxxnet_tpu.telemetry.http import ObservabilityServer
             self.metrics_server = ObservabilityServer(
                 telemetry.get(), int(self.metrics_port),
-                host=self.metrics_host)
+                host=self.metrics_host,
+                predict_backend=(self if self.http_port is not None
+                                 else None))
             self.metrics_server.start()
             telemetry.event("observability", op="http_start",
                             port=self.metrics_server.port,
-                            host=self.metrics_host)
+                            host=self.metrics_host,
+                            predict=self.http_port is not None)
         with self._cond:
             # published under the lock that guards it: a replica from
             # a previous start/stop cycle draining late must not read
@@ -443,12 +575,27 @@ class Server:
                                  name=f"serve-replica-{i}", daemon=True)
             self._threads.append(t)
             t.start()
+        if self.swap_watch and self._swap_thread is None:
+            # checkpoint watcher: the file's CURRENT state counts as
+            # already-served (the Server was presumably built from
+            # it); only a subsequent publish triggers a swap
+            with self._swap_lock:
+                self._swap_seen = self._swap_stat()
+            self._swap_stop.clear()
+            self._swap_thread = threading.Thread(
+                target=self._swap_watch_loop,
+                name="serve-swap-watch", daemon=True)
+            self._swap_thread.start()
         return self
 
     def stop(self, drain: bool = True) -> Dict[str, Any]:
         """Stop the replicas - after draining the queue (default), or
         immediately failing queued requests (drain=False) - and return
         stats(). Idempotent."""
+        if self._swap_thread is not None:
+            self._swap_stop.set()
+            self._swap_thread.join(timeout=10.0)
+            self._swap_thread = None
         with self._cond:
             self._draining = True
             if not drain:
@@ -458,6 +605,12 @@ class Server:
                     it.future._set_error(
                         RuntimeError("server stopped before dispatch"))
             self._cond.notify_all()
+            shed_held = self._shed_health
+            self._shed_health = False
+        if shed_held:
+            # a stopped server is not "overloaded"; release the 503
+            # so a restart doesn't inherit a stale verdict
+            telemetry.get().health.clear("serve_shed")
         for t in self._threads:
             t.join(timeout=60.0)
         self._threads = []
@@ -484,13 +637,21 @@ class Server:
         return False
 
     # -- submission --------------------------------------------------------
-    def submit(self, data: np.ndarray, extras: Sequence = ()):
+    def submit(self, data: np.ndarray, extras: Sequence = (),
+               deadline_ms: Optional[float] = None):
         """Enqueue one request: data is (n, c, y, x) rows or a single
         (c, y, x) instance; extras (if the net declares extra inputs)
         ride along row-aligned. Returns a future whose result() is the
         raw final-node rows, (n, width) - predictions_from_rows turns
         them into predict()-style labels. Thread-safe; requests wider
-        than the largest bucket split transparently."""
+        than the largest bucket split transparently.
+
+        `deadline_ms` overrides the server default (serve_deadline_ms;
+        0 = none): a request still queued past its deadline is dropped
+        BEFORE dispatch and its future raises DeadlineExpiredError.
+        With `queue_limit` set, a submit that would push the queue
+        past the limit raises QueueFullError instead of enqueueing
+        (load shedding - the HTTP front maps it to 429+Retry-After)."""
         if not self._started:
             raise RuntimeError("Server not started (call start())")
         data = np.ascontiguousarray(data)
@@ -518,6 +679,9 @@ class Server:
         # oversize request renders as ONE span tree in the exported
         # Chrome trace; pid-scoped so multi-process traces merge
         trace = f"{os.getpid():x}-{next(self._trace_seq):06d}"
+        eff_ms = (self.deadline_ms if deadline_ms is None
+                  else float(deadline_ms))
+        deadline = t_submit + eff_ms / 1e3 if eff_ms > 0 else 0.0
         nparts = -(-data.shape[0] // self.max_batch)
         items = []
         for part, lo in enumerate(
@@ -525,15 +689,49 @@ class Server:
             hi = lo + self.max_batch
             items.append(_WorkItem(
                 data[lo:hi], [e[lo:hi] for e in extras], t_submit,
-                trace=trace, part=part, nparts=nparts))
+                trace=trace, part=part, nparts=nparts,
+                deadline=deadline))
+        items[0].future.trace = trace
+        shed_depth = -1
         with self._cond:
             if self._draining:
                 raise RuntimeError("server is stopping")
-            for it in items:
-                self._queue.append(it)
-                self._queued_rows += it.n
-            depth = self._queued_rows
-            self._cond.notify_all()
+            if (self.queue_limit > 0 and
+                    self._queued_rows + data.shape[0]
+                    > self.queue_limit):
+                # hard admission bound: reject, do NOT enqueue. The
+                # shed verdict (503 on /healthz) holds until the
+                # queue drains below half the limit for the
+                # hysteresis window (_maybe_recover)
+                shed_depth = self._queued_rows
+                self._last_shed_t = t_submit
+                flip = not self._shed_health
+                self._shed_health = True
+            else:
+                for it in items:
+                    self._queue.append(it)
+                    self._queued_rows += it.n
+                depth = self._queued_rows
+                self._cond.notify_all()
+        if shed_depth >= 0:
+            retry_s = self._retry_after(shed_depth + data.shape[0])
+            with self._lock:
+                self._n_shed += 1
+                self._n_shed_rows += data.shape[0]
+            telemetry.inc("serve.shed_total")
+            telemetry.inc("serve.shed_rows", data.shape[0])
+            if flip:
+                reason = (f"load shed: queue {shed_depth} rows + "
+                          f"{data.shape[0]} > limit {self.queue_limit}")
+                telemetry.get().health.set_unhealthy(
+                    "serve_shed", reason)
+                telemetry.event("serve", op="shed",
+                                queue_depth=shed_depth,
+                                limit=self.queue_limit)
+            raise QueueFullError(
+                f"serve queue full ({shed_depth} rows >= limit "
+                f"{self.queue_limit}); retry in {retry_s:.2f}s",
+                retry_after_s=retry_s, queue_depth=shed_depth)
         with self._lock:
             self._n_requests += 1
             self._n_rows += data.shape[0]
@@ -548,18 +746,108 @@ class Server:
             return items[0].future
         return _JoinedFuture([it.future for it in items])
 
+    # -- backpressure helpers ----------------------------------------------
+    def _retry_after(self, backlog_rows: int) -> float:
+        """Retry-After advice for a shed request: the time the current
+        backlog takes to drain at the measured (EWMA) drain rate,
+        clamped to [0.1s, 60s]. Before any batch has dispatched the
+        rate is unknown and the floor applies."""
+        with self._lock:
+            rate = self._drain_rate
+        if rate <= 0:
+            return 1.0
+        return min(60.0, max(0.1, backlog_rows / rate))
+
+    def _maybe_recover(self) -> None:
+        """Shed->healthy hysteresis: clear the `serve_shed` health
+        verdict once the queue has drained below HALF the limit AND
+        no shed happened for shed_clear_ms - a single drained batch
+        amid a storm must not flap /healthz."""
+        now = time.monotonic()
+        cleared = False
+        with self._cond:
+            if (self._shed_health
+                    and self._queued_rows * 2 < max(self.queue_limit, 1)
+                    and (now - self._last_shed_t)
+                    >= self.shed_clear_ms / 1e3):
+                self._shed_health = False
+                cleared = True
+        if cleared:
+            telemetry.get().health.clear("serve_shed")
+            telemetry.event("serve", op="shed_recovered",
+                            limit=self.queue_limit)
+
+    def _fail_expired(self, it: _WorkItem, now: float) -> None:
+        """Resolve a deadline-expired item (called OUTSIDE _cond: the
+        future Event set + registry counters need no queue state)."""
+        with self._lock:
+            self._n_expired += 1
+        telemetry.inc("serve.deadline_expired")
+        waited_ms = (now - it.t_submit) * 1e3
+        it.future._set_error(DeadlineExpiredError(
+            f"request deadline expired after {waited_ms:.1f} ms in "
+            "queue (dropped before dispatch)"))
+        telemetry.event("serve", op="deadline_expired",
+                        trace=it.trace, part=it.part, rows=it.n,
+                        waited_ms=round(waited_ms, 3))
+
     # -- dispatchers -------------------------------------------------------
     def _collect(self) -> Optional[List[_WorkItem]]:
         """Admission policy: block for work, then coalesce queued
         items up to max_batch rows, waiting at most max_wait_ms past
         the FIRST item's submit time for the batch to fill
-        (fill-or-timeout). Returns None when stopping and drained."""
+        (fill-or-timeout). Deadline-expired items are dropped here,
+        before a bucket slot is spent on them. Returns None when
+        stopping and drained; an empty list means "nothing live this
+        round, loop again" (everything popped had expired)."""
+        expired: List[_WorkItem] = []
+        items = self._collect_locked(expired)
+        if expired:
+            now = time.monotonic()
+            for it in expired:
+                self._fail_expired(it, now)
+        if items is not None:
+            self._maybe_recover()
+        return items
+
+    def _collect_locked(
+            self, expired: List[_WorkItem]
+    ) -> Optional[List[_WorkItem]]:
         with self._cond:
-            while not self._queue:
-                if self._draining:
-                    return None
-                self._cond.wait(0.05)
-            first = self._queue.popleft()
+            first = None
+            while first is None:
+                if not self._queue:
+                    if self._draining:
+                        return None
+                    if expired:
+                        # resolve the drops promptly instead of
+                        # blocking here with their futures pending
+                        break
+                    if (self._shed_health and self._queued_rows * 2
+                            < max(self.queue_limit, 1)
+                            and time.monotonic() - self._last_shed_t
+                            >= self.shed_clear_ms / 1e3):
+                        # storm over, traffic gone: surface so the
+                        # caller can clear the shed 503 (recovery
+                        # must not wait for the next request)
+                        break
+                    self._cond.wait(0.05)
+                    continue
+                # pop the next un-expired item; expired ones
+                # accumulate for post-lock resolution
+                now = time.monotonic()
+                while self._queue:
+                    it = self._queue.popleft()
+                    self._queued_rows -= it.n
+                    if it.deadline and now > it.deadline:
+                        expired.append(it)
+                        continue
+                    first = it
+                    break
+            if first is None:
+                telemetry.set_gauge("serve.queue_depth",
+                                    self._queued_rows)
+                return []
             # coalesce stamp: end of this item's queue phase (request
             # tracing's queue-vs-device cut)
             first.t_collect = time.monotonic()
@@ -568,8 +856,15 @@ class Server:
             deadline = first.t_submit + self.max_wait_ms / 1e3
             while total < self.max_batch:
                 if self._queue:
-                    if self._queue[0].n <= self.max_batch - total:
+                    head = self._queue[0]
+                    if head.deadline and time.monotonic() > head.deadline:
+                        self._queue.popleft()
+                        self._queued_rows -= head.n
+                        expired.append(head)
+                        continue
+                    if head.n <= self.max_batch - total:
                         it = self._queue.popleft()
+                        self._queued_rows -= it.n
                         it.t_collect = time.monotonic()
                         items.append(it)
                         total += it.n
@@ -579,7 +874,6 @@ class Server:
                 if wait <= 0 or self._draining:
                     break
                 self._cond.wait(min(wait, 0.05))
-            self._queued_rows -= total
             telemetry.set_gauge("serve.queue_depth", self._queued_rows)
             return items
 
@@ -613,8 +907,21 @@ class Server:
                 fields={"rows": total, "requests": len(items)})
         t_dispatch = time.monotonic()
         try:
+            # serve-side fault points (utils/fault.py, CXXNET_FAULT):
+            # delay stalls the dispatch (deadline/backpressure tests),
+            # error crashes it (the replica recovers, futures fail)
+            fault.fault_point("serve_dispatch_delay")
+            fault.fault_point("serve_dispatch_error")
+            # hot-swap consistency: snapshot (fn, params) under the
+            # swap lock so a batch binds ONE weight generation; the
+            # dispatch itself runs outside the lock (GL015 - never
+            # hold a lock across a jax boundary). An in-flight batch
+            # that snapshotted before a swap finishes on old weights.
+            with self._swap_lock:
+                fn = self._fn
+                params = self.trainer.state["params"]
             gdata, gextras = self.trainer.stage_infer_rows(data, extras)
-            out = self._fn(self.trainer.state["params"], gdata, gextras)
+            out = fn(params, gdata, gextras)
             rows = distributed.fetch_local(out)
         except BaseException as e:
             # a FAILED dispatch must not read as a hung one: the
@@ -662,6 +969,18 @@ class Server:
             self._n_batches += 1
             self._n_padding += bucket - total
             self._bucket_hits[bucket] += 1
+            # drain-rate EWMA (rows/s across all replicas): Retry-After
+            # advice for shed requests derives from it. Measured over
+            # inter-completion gaps so replica overlap and admission
+            # waits are priced in, not just device time.
+            if self._last_drain_t > 0:
+                gap = t_done - self._last_drain_t
+                if gap > 1e-6:
+                    inst = total / gap
+                    self._drain_rate = (
+                        inst if self._drain_rate <= 0
+                        else 0.7 * self._drain_rate + 0.3 * inst)
+            self._last_drain_t = t_done
         telemetry.inc("serve.batches")
         telemetry.inc("serve.padding_rows", bucket - total)
         # serving progress beacon: a wedged dispatch (hung backend)
@@ -673,6 +992,10 @@ class Server:
             items = self._collect()
             if items is None:
                 return
+            if not items:
+                # nothing live this round (expired drops resolved /
+                # shed recovery surfaced) - nothing to dispatch
+                continue
             try:
                 self._run_batch(items)
             except BaseException as e:  # noqa: BLE001 - delivered via futures
@@ -687,6 +1010,211 @@ class Server:
                     if not it.future.done():
                         it.future._set_error(e)
 
+    # -- checkpoint hot-swap -----------------------------------------------
+    def _swap_stat(self) -> Optional[Tuple[int, int]]:
+        try:
+            st = os.stat(self.swap_watch)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
+
+    def _swap_watch_loop(self) -> None:
+        """Poll the published-checkpoint path every swap_poll_ms and
+        swap on any (mtime, size) change. The stat is recorded before
+        the attempt, so a rejected (torn) file is skipped ONCE and
+        not re-validated in a hot loop; publishing a fixed file
+        changes the stat again and retries."""
+        poll_s = max(self.swap_poll_ms, 10.0) / 1e3
+        while not self._swap_stop.wait(poll_s):
+            cur = self._swap_stat()
+            with self._swap_lock:
+                if cur is None or cur == self._swap_seen:
+                    continue
+                self._swap_seen = cur
+            try:
+                self.swap_to(self.swap_watch)
+            except BaseException as e:  # noqa: BLE001 - keep serving
+                telemetry.stderr(
+                    f"serve: swap attempt failed: "
+                    f"{type(e).__name__}: {e}\n",
+                    event_kind="swap", op="error",
+                    error=f"{type(e).__name__}: {e}")
+
+    def _params_mismatch(self, cur, new) -> Optional[str]:
+        """A swap must be weight-compatible with the warmed
+        executables: identical param tree (layer/param keys) and leaf
+        shapes. Returns the first mismatch as a reason string."""
+        for lk in cur:
+            if lk not in new:
+                return f"checkpoint missing layer {lk!r}"
+            for pn in cur[lk]:
+                if pn not in new[lk]:
+                    return f"checkpoint missing param {lk}/{pn}"
+                want = tuple(cur[lk][pn].shape)
+                got = tuple(np.shape(new[lk][pn]))
+                if want != got:
+                    return (f"shape mismatch at {lk}/{pn}: "
+                            f"checkpoint {got} vs serving {want}")
+        extra = [f"{lk}/{pn}" for lk in new for pn in new[lk]
+                 if lk not in cur or pn not in cur[lk]]
+        if extra:
+            return f"checkpoint has unknown params: {extra[:3]}"
+        return None
+
+    def swap_to(self, path: str) -> bool:
+        """Zero-downtime weight swap from an atomic checksummed
+        checkpoint (docs/SERVING.md "Hot-swap runbook"): validate the
+        crc32 trailer, load, verify the param tree matches, stage the
+        new params to device (all outside any lock), then switch
+        between batches under _swap_lock. In-flight batches bound the
+        old params at dispatch and finish on the old weights; no
+        request is dropped. Returns True on an applied swap; a
+        torn/corrupt/mismatched checkpoint emits `swap` op=rejected
+        and the old weights keep serving (False)."""
+        from cxxnet_tpu.nnet import checkpoint
+        from cxxnet_tpu.parallel import distributed
+        t0 = time.perf_counter()
+        blob = None
+        reason = checkpoint.validate_file(path)
+        if reason is None:
+            try:
+                with open(path, "rb") as fi:
+                    blob = checkpoint.load_model(fi)
+            except (OSError, ValueError) as e:
+                reason = f"{type(e).__name__}: {e}"
+        if reason is None:
+            reason = self._params_mismatch(
+                self.trainer.state["params"], blob["params"])
+        if reason is not None:
+            with self._lock:
+                self._n_swap_rejected += 1
+            telemetry.inc("serve.swap_rejected")
+            telemetry.stderr(
+                f"serve: checkpoint swap rejected ({path}): "
+                f"{reason}\n",
+                event_kind="swap", op="rejected", path=path,
+                reason=reason)
+            return False
+        # stage the new weights at the stored sharded layout (the
+        # same put_global_full landing set_weight uses) BEFORE taking
+        # the swap lock - device_put is a dispatch boundary and must
+        # never run under a lock (GL015 / the runtime lock audit)
+        cur = self.trainer.state["params"]
+        pstore = self.trainer._params_store_shard
+        staged = {
+            lk: {pn: distributed.put_global_full(
+                np.ascontiguousarray(blob["params"][lk][pn]),
+                pstore[lk][pn])
+                for pn in cur[lk]}
+            for lk in cur}
+        with self._swap_lock:
+            self.trainer.state["params"] = staged
+            self.trainer.epoch = int(blob.get("epoch",
+                                              self.trainer.epoch))
+            old_fold = self.trainer._fold_epoch
+            # frozen fold/quant calibration described the OLD weights:
+            # retire it (epoch bump + stale-executable eviction, the
+            # PR 10/12 mechanism). On the no-passes path this is a
+            # no-op and params stay plain jit ARGUMENTS - the swap is
+            # a zero-recompile, bitwise switch.
+            self.trainer._retire_calibration_state()
+            rewarmed = self.trainer._fold_epoch != old_fold
+            if rewarmed:
+                self._fn = self.trainer._infer_fn(self.node)
+        if rewarmed:
+            # new fold epoch = new executables: re-warm every bucket
+            # so steady state stays recompile-free and /executables
+            # lists the new fingerprints (epoch is part of them)
+            self.warmup()
+        with self._lock:
+            self._n_swaps += 1
+        telemetry.inc("serve.swaps")
+        telemetry.event("swap", op="applied", path=path,
+                        epoch=self.trainer.epoch, rewarmed=rewarmed,
+                        secs=round(time.perf_counter() - t0, 4))
+        return True
+
+    # -- HTTP request path -------------------------------------------------
+    def handle_predict(self, body: bytes):
+        """The /predict POST backend (telemetry/http.py routes here
+        when this Server attached with http_port/serve_port): JSON
+        {"data": rows, "extras": [...], "deadline_ms": N, "raw": bool}
+        in; {"predictions": [...], "rows": n, "trace": id} out. data
+        is (n,c,y,x) nested, flat (n, c*y*x), or one instance. Maps
+        QueueFullError -> 429 + Retry-After, deadline expiry/timeout
+        -> 504, validation -> 400, dispatch failure -> 500. Returns
+        (status, extra_headers, body_bytes)."""
+        import json
+
+        def err(code: int, msg: str, **extra):
+            payload = {"error": msg}
+            payload.update(extra)
+            return code, {}, json.dumps(payload).encode()
+
+        t0 = time.monotonic()
+        try:
+            req = json.loads(body.decode("utf-8")) if body else {}
+        except (ValueError, UnicodeDecodeError):
+            return err(400, "request body must be a JSON object")
+        if not isinstance(req, dict) or "data" not in req:
+            return err(400, 'request JSON needs a "data" field '
+                            '(rows to predict)')
+        try:
+            data = np.asarray(req["data"], dtype=np.float32)
+        except (ValueError, TypeError):
+            return err(400, '"data" must be a numeric array')
+        c, y, x = self._input_dims
+        width = c * y * x
+        if data.ndim == 1 and data.size == width:
+            data = data.reshape(1, c, y, x)
+        elif data.ndim == 2 and data.shape[-1] == width:
+            data = data.reshape(-1, c, y, x)
+        deadline_ms = req.get("deadline_ms")
+        try:
+            extras = [np.asarray(e, dtype=np.float32)
+                      for e in req.get("extras", ())]
+            fut = self.submit(data, extras, deadline_ms=deadline_ms)
+        except QueueFullError as e:
+            # ceil seconds for the header (int per RFC 9110), exact
+            # advice in the body; [1, 60] keeps a confused client
+            # from either hammering or giving up
+            secs = max(1, min(60, int(-(-e.retry_after_s // 1))))
+            return (429, {"Retry-After": str(secs)},
+                    json.dumps({
+                        "error": "queue full (load shed)",
+                        "retry_after_s": round(e.retry_after_s, 3),
+                        "queue_depth": e.queue_depth}).encode())
+        except (ValueError, TypeError) as e:
+            return err(400, str(e))
+        except RuntimeError as e:
+            return err(503, str(e))
+        eff_ms = (self.deadline_ms if deadline_ms is None
+                  else float(deadline_ms))
+        timeout = eff_ms / 1e3 + 5.0 if eff_ms > 0 else 300.0
+        try:
+            rows = fut.result(timeout=timeout)
+        except DeadlineExpiredError as e:
+            return err(504, str(e), trace=fut.trace)
+        except TimeoutError:
+            return err(504, "timed out waiting for the result",
+                       trace=fut.trace)
+        except BaseException as e:  # noqa: BLE001 - dispatch error -> 500
+            return err(500, f"{type(e).__name__}: {e}",
+                       trace=fut.trace)
+        rows = np.asarray(rows)
+        out = {
+            "predictions": [float(v)
+                            for v in predictions_from_rows(rows)],
+            "rows": int(rows.shape[0]),
+            "trace": fut.trace,
+            "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+        }
+        if req.get("raw"):
+            # raw final-node rows: what the bitwise swap proofs and
+            # the smoke's cold-restart comparison consume
+            out["outputs"] = rows.reshape(rows.shape[0], -1).tolist()
+        return 200, {}, json.dumps(out).encode()
+
     # -- reporting ---------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """Product-surface summary: request/row/batch/padding counts,
@@ -699,9 +1227,16 @@ class Server:
                 "batches": self._n_batches,
                 "padding_rows": self._n_padding,
                 "errors": self._n_errors,
+                "shed_requests": self._n_shed,
+                "shed_rows": self._n_shed_rows,
+                "deadline_expired": self._n_expired,
+                "swaps": self._n_swaps,
+                "swap_rejected": self._n_swap_rejected,
+                "drain_rows_per_s": round(self._drain_rate, 2),
                 "buckets": {b: n for b, n in self._bucket_hits.items()},
                 "request_sizes": dict(self._size_hist),
             }
+        out["queue_limit"] = self.queue_limit
         out["warmup_s"] = round(self.warmup_s, 4)
         for hist, stem in ((self._lat, "latency"),
                            (self._qlat, "queue"),

@@ -31,9 +31,15 @@ serving-transport roadmap item) exposing
   in-flight dispatches. The same facts export as labeled Prometheus
   series (``cxxnet_executable_*{fingerprint=...}``) on ``/metrics``.
 
-Armed only by ``metrics_port=`` (or ``Server(metrics_port=...)``);
-with the key unset this module is never imported - the CLI
-byte-parity contract costs nothing.
+With a serving backend attached (``Server(http_port=...)`` / the CLI
+``serve_port=`` key) the same listener additionally routes ``POST
+/predict`` - the serving request path (docs/SERVING.md "Serving over
+HTTP"); the protocol mapping (429 + Retry-After on shed, 504 on
+deadline expiry) lives on the Server, this module is transport only.
+
+Armed only by ``metrics_port=`` / ``serve_port=`` (or
+``Server(metrics_port=...)``); with the keys unset this module is
+never imported - the CLI byte-parity contract costs nothing.
 """
 
 from __future__ import annotations
@@ -191,17 +197,43 @@ def validate_exposition(text: str) -> List[str]:
     return bad
 
 
-def _make_handler(tel):
+def _make_handler(tel, predict_backend=None):
     class _Handler(BaseHTTPRequestHandler):
         # one scrape per GET; no keep-alive state worth protocol 1.1
         protocol_version = "HTTP/1.0"
 
-        def _send(self, code: int, body: bytes, ctype: str) -> None:
+        def _send(self, code: int, body: bytes, ctype: str,
+                  headers=None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def do_POST(self) -> None:  # noqa: N802 - stdlib handler API
+            # the serving request path (docs/SERVING.md "Serving over
+            # HTTP"): present only when a Server attached with
+            # serve_port/http_port; all protocol mapping (429 +
+            # Retry-After, 504 deadline, 400/500) lives in
+            # Server.handle_predict - this handler is pure transport
+            path = self.path.split("?", 1)[0]
+            try:
+                if path != "/predict" or predict_backend is None:
+                    self._send(404, b"not found\n", "text/plain")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    n = 0
+                body = self.rfile.read(n) if n > 0 else b""
+                code, headers, out = predict_backend.handle_predict(
+                    body)
+                self._send(code, out, "application/json",
+                           headers=headers)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # caller went away mid-write; nothing to save
 
         def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
             path = self.path.split("?", 1)[0]
@@ -255,9 +287,11 @@ class ObservabilityServer:
     is immediately readable), serves on a daemon thread after
     ``start()``, and ``close()`` shuts the socket down and joins."""
 
-    def __init__(self, tel, port: int = 0, host: str = "0.0.0.0"):
-        self._srv = ThreadingHTTPServer((host, int(port)),
-                                        _make_handler(tel))
+    def __init__(self, tel, port: int = 0, host: str = "0.0.0.0",
+                 predict_backend=None):
+        self._srv = ThreadingHTTPServer(
+            (host, int(port)),
+            _make_handler(tel, predict_backend=predict_backend))
         self._srv.daemon_threads = True
         self.port: int = self._srv.server_address[1]
         self.host = host

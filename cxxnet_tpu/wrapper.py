@@ -150,17 +150,29 @@ class Net:
     # -- serving (docs/SERVING.md) -------------------------------------
     def serve_start(self, max_batch: int = 0,
                     max_wait_ms: Optional[float] = None,
-                    replicas: Optional[int] = None) -> None:
+                    replicas: Optional[int] = None,
+                    http_port: Optional[int] = None,
+                    queue_limit: Optional[int] = None,
+                    deadline_ms: Optional[float] = None,
+                    swap_watch: Optional[str] = None) -> None:
         """Start the continuous-batching server over this net's
         inference executable: bucket executables compiled + warmed
         here, dispatcher replicas spawned. Unset arguments fall back
         to the net's serve_* config keys (serve_max_batch /
-        serve_max_wait_ms / serve_replicas)."""
+        serve_max_wait_ms / serve_replicas / serve_port /
+        serve_queue_limit / serve_deadline_ms / swap_watch -
+        docs/SERVING.md). http_port attaches the /predict HTTP
+        request path (0 = ephemeral; read the bound port off
+        `net._server.metrics_server.port`); queue_limit arms load
+        shedding (QueueFullError / HTTP 429); swap_watch arms the
+        zero-downtime checkpoint hot-swap poller."""
         if getattr(self, "_server", None) is not None:
             raise RuntimeError("server already started")
         from cxxnet_tpu.serve import Server
         srv = Server(self._net, max_batch=max_batch,
-                     max_wait_ms=max_wait_ms, replicas=replicas)
+                     max_wait_ms=max_wait_ms, replicas=replicas,
+                     http_port=http_port, queue_limit=queue_limit,
+                     deadline_ms=deadline_ms, swap_watch=swap_watch)
         # attach only once running: a warmup failure (compile error,
         # OOM) must leave serve_start retryable, not wedge the Net
         # behind "server already started"
@@ -181,6 +193,16 @@ class Net:
             raise RuntimeError("call serve_start first")
         fut = self._server.submit(np.asarray(data, dtype=np.float32))
         return fut.result() if block else fut
+
+    def serve_swap(self, path: str) -> bool:
+        """Hot-swap the running server's weights from an on-disk
+        checkpoint (docs/SERVING.md "Hot-swap runbook"): validated,
+        staged and switched between batches with zero dropped
+        requests. Returns False (and keeps the old weights serving)
+        when the file is torn/corrupt/shape-mismatched."""
+        if getattr(self, "_server", None) is None:
+            raise RuntimeError("call serve_start first")
+        return self._server.swap_to(path)
 
     def serve_stop(self) -> dict:
         """Drain + stop the server; returns its stats() summary

@@ -562,3 +562,405 @@ def test_bitwise_serve_equals_unbatched_predict(extra):
         env=PARITY_ENV, capture_output=True, text=True, timeout=540)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "SERVE_PARITY=OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# production front: backpressure, deadlines, /predict, hot-swap
+# (docs/SERVING.md "Serving over HTTP" / "Hot-swap runbook")
+# ---------------------------------------------------------------------------
+def _post_predict(port, payload, timeout=30):
+    import json
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _stall_dispatch(n, secs):
+    """Arm n consecutive serve-side dispatch delays (fault registry)."""
+    from cxxnet_tpu.utils import fault
+    fault.clear()
+    for i in range(n):
+        fault.inject("serve_dispatch_delay", "delay", str(secs),
+                     at=i + 1)
+
+
+def test_queue_limit_rejects_with_typed_error():
+    """Past queue_limit rows, submit() raises QueueFullError carrying
+    Retry-After advice - it never enqueues (hard admission bound)."""
+    from cxxnet_tpu.serve import QueueFullError
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 queue_limit=16)
+    srv.warmup()
+    _stall_dispatch(64, 0.1)
+    srv.start()
+    rng = np.random.RandomState(5)
+    futs, errs = [], []
+    try:
+        for _ in range(30):
+            try:
+                futs.append(srv.submit(req(rng, 4)))
+            except QueueFullError as e:
+                errs.append(e)
+        assert errs, "queue never filled past the limit"
+        e = errs[0]
+        assert e.retry_after_s > 0
+        assert e.queue_depth <= 16
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        fault.clear()
+        stats = srv.stop()
+    # every accepted request resolved; every shed one was counted
+    assert stats["errors"] == 0
+    assert stats["shed_requests"] == len(errs)
+    assert stats["shed_rows"] == 4 * len(errs)
+    reg = telemetry.get().registry
+    assert reg.counter("serve.shed_total").value == len(errs)
+    assert reg.counter("serve.shed_rows").value == 4 * len(errs)
+
+
+def test_shed_flips_healthz_503_then_recovers():
+    """Shedding marks the `serve_shed` health source unhealthy (503
+    on /healthz); once the queue drains below half the limit for the
+    hysteresis window, it recovers to 200 without a restart."""
+    from cxxnet_tpu.serve import QueueFullError
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=2,
+                 queue_limit=8)
+    srv.shed_clear_ms = 200.0
+    srv.warmup()
+    _stall_dispatch(32, 0.1)
+    srv.start()
+    rng = np.random.RandomState(6)
+    futs, shed = [], 0
+    try:
+        for _ in range(30):
+            try:
+                futs.append(srv.submit(req(rng, 4)))
+            except QueueFullError:
+                shed += 1
+        assert shed > 0
+        ok, reasons = telemetry.get().health.status()
+        assert not ok and "serve_shed" in reasons, reasons
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        fault.clear()
+    # recovery is the replicas' job (hysteresis window), no new
+    # submits needed
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if telemetry.get().health.ok:
+            break
+        time.sleep(0.05)
+    assert telemetry.get().health.ok, "shed verdict never cleared"
+    srv.stop()
+
+
+def test_deadline_expires_before_dispatch():
+    """A request whose deadline lapses in the queue resolves with
+    DeadlineExpiredError and never spends a bucket slot: no dispatch,
+    no error counted - dropped at collect time."""
+    from cxxnet_tpu.serve import DeadlineExpiredError
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1)
+    srv.warmup()
+    _stall_dispatch(4, 0.4)
+    srv.start()
+    rng = np.random.RandomState(7)
+    try:
+        blocker = srv.submit(req(rng, 8))   # pins the only replica
+        doomed = srv.submit(req(rng, 2), deadline_ms=50)
+        with pytest.raises(DeadlineExpiredError):
+            doomed.result(timeout=30)
+        blocker.result(timeout=30)
+    finally:
+        fault.clear()
+        stats = srv.stop()
+    assert stats["deadline_expired"] == 1
+    assert stats["errors"] == 0
+    assert telemetry.get().registry.counter(
+        "serve.deadline_expired").value == 1
+    # the expired request's rows were never dispatched
+    assert stats["rows"] - 2 == sum(
+        b * n for b, n in stats["buckets"].items()) - stats[
+            "padding_rows"]
+
+
+def test_http_predict_roundtrip_and_errors(trainer):
+    """The /predict POST path: 200 with predictions matching the
+    in-process surface, 400 on malformed input, echoing the ingress-
+    minted trace id."""
+    telemetry.reset_for_tests()
+    srv = Server(trainer, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 http_port=0)
+    srv.warmup()
+    srv.start()
+    try:
+        port = srv.metrics_server.port
+        rng = np.random.RandomState(8)
+        data = req(rng, 3)
+        code, _, out = _post_predict(
+            port, {"data": data.reshape(3, -1).tolist(), "raw": True})
+        assert code == 200
+        assert out["rows"] == 3 and out["trace"]
+        ref = srv.submit(data).result(timeout=30)
+        assert np.array_equal(
+            np.asarray(out["outputs"], np.float32), ref)
+        assert out["predictions"] == [
+            float(v) for v in predictions_from_rows(ref)]
+        # the ingress trace id resolves through the queue/bucket
+        # machinery like any in-process submit
+        assert "-" in out["trace"]
+        code, _, out = _post_predict(port, {"data": "nonsense"})
+        assert code == 400 and "error" in out
+        code, _, out = _post_predict(port, {})
+        assert code == 400
+    finally:
+        srv.stop()
+
+
+def test_http_storm_gets_429_with_sane_retry_after(trainer):
+    """Past queue_limit the HTTP caller gets 429 + Retry-After (int
+    seconds in [1, 60], exact advice in the body) while accepted
+    requests still resolve - explicit shedding, not queue collapse."""
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    srv = Server(trainer, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 http_port=0, queue_limit=4)
+    srv.warmup()
+    # 0.3s per dispatch: any two requests overlapping a dispatch
+    # window exceed the 4-row limit, so the storm MUST shed
+    _stall_dispatch(64, 0.3)
+    srv.start()
+    try:
+        port = srv.metrics_server.port
+        rng = np.random.RandomState(9)
+        payload = {"data": req(rng, 4).reshape(4, -1).tolist()}
+        results = []
+        lock = threading.Lock()
+
+        def hammer():
+            for _ in range(6):
+                code, headers, out = _post_predict(port, payload,
+                                                   timeout=120)
+                with lock:
+                    results.append((code, headers, out))
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        codes = [c for c, _, _ in results]
+        assert 200 in codes and 429 in codes, codes
+        for code, headers, out in results:
+            if code != 429:
+                continue
+            retry = int(headers["Retry-After"])
+            assert 1 <= retry <= 60
+            assert out["retry_after_s"] > 0
+            assert out["queue_depth"] <= 4
+    finally:
+        fault.clear()
+        stats = srv.stop()
+    assert stats["errors"] == 0
+    assert stats["shed_requests"] == sum(
+        1 for c in codes if c == 429)
+
+
+def test_http_deadline_maps_504(trainer):
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    srv = Server(trainer, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 http_port=0)
+    srv.warmup()
+    _stall_dispatch(4, 0.4)
+    srv.start()
+    try:
+        port = srv.metrics_server.port
+        rng = np.random.RandomState(10)
+        blocker = srv.submit(req(rng, 8))
+        code, _, out = _post_predict(
+            port, {"data": req(rng, 2).reshape(2, -1).tolist(),
+                   "deadline_ms": 50})
+        assert code == 504 and "error" in out
+        blocker.result(timeout=30)
+    finally:
+        fault.clear()
+        srv.stop()
+
+
+def _save_checkpoint(tr, path):
+    with open(path, "wb") as fo:
+        tr.save_model(fo)
+
+
+def test_hot_swap_mid_storm_zero_drops_bitwise_switch(tmp_path):
+    """A swap under live traffic drops nothing: every future resolves
+    error-free, pre-swap answers match the old weights, and post-swap
+    answers are BITWISE the new checkpoint's (params are executable
+    arguments - same program, zero recompiles)."""
+    telemetry.reset_for_tests()
+    tr_old = make_trainer()
+    tr_new = make_trainer("seed = 99\n")
+    ck = str(tmp_path / "new.model")
+    _save_checkpoint(tr_new, ck)
+    srv = Server(tr_old, max_batch=8, max_wait_ms=1.0, replicas=2)
+    srv.warmup()
+    n_warm = srv.executable_cache_size()
+    srv.start()
+    rng = np.random.RandomState(11)
+    probe = req(rng, 5)
+    try:
+        old_ref = srv.submit(probe).result(timeout=60)
+        futs = [srv.submit(req(rng, s))
+                for s in ([1, 3, 8, 2, 5, 7] * 4)]
+        assert srv.swap_to(ck) is True
+        for f in futs:
+            f.result(timeout=120)  # in-flight + queued all resolve
+        new_out = srv.submit(probe).result(timeout=60)
+        stats = srv.stats()
+        assert stats["errors"] == 0
+        assert stats["swaps"] == 1
+        assert srv.executable_cache_size() == n_warm, \
+            "swap must not recompile (params are arguments)"
+    finally:
+        srv.stop()
+    # cold reference: a fresh server over the new checkpoint's weights
+    srv2 = Server(tr_new, max_batch=8, max_wait_ms=1.0, replicas=1)
+    srv2.warmup()
+    srv2.start()
+    try:
+        cold_ref = srv2.submit(probe).result(timeout=60)
+    finally:
+        srv2.stop()
+    assert not np.array_equal(old_ref, new_out), \
+        "swap visibly changed the weights"
+    assert np.array_equal(new_out, cold_ref), \
+        "post-swap serving must be bitwise the new checkpoint"
+    assert telemetry.get().registry.counter(
+        "serve.swaps").value == 1
+
+
+def test_torn_checkpoint_rejected_keeps_serving(tmp_path):
+    """A torn (truncated, trailer-less) checkpoint is rejected with a
+    swap.rejected verdict; the old weights keep serving unchanged."""
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    tr_new = make_trainer("seed = 99\n")
+    good = str(tmp_path / "good.model")
+    torn = str(tmp_path / "torn.model")
+    _save_checkpoint(tr_new, good)
+    blob = open(good, "rb").read()
+    with open(torn, "wb") as fo:
+        fo.write(blob[:len(blob) // 2])
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1)
+    srv.warmup()
+    srv.start()
+    rng = np.random.RandomState(12)
+    probe = req(rng, 4)
+    try:
+        before = srv.submit(probe).result(timeout=60)
+        assert srv.swap_to(torn) is False
+        after = srv.submit(probe).result(timeout=60)
+        stats = srv.stats()
+    finally:
+        srv.stop()
+    assert np.array_equal(before, after), \
+        "rejected swap must not perturb serving"
+    assert stats["swaps"] == 0
+    assert stats["swap_rejected"] == 1
+    assert stats["errors"] == 0
+    assert telemetry.get().registry.counter(
+        "serve.swap_rejected").value == 1
+
+
+def test_swap_watcher_picks_up_published_checkpoint(tmp_path):
+    """The swap_watch poller: an atomic publish_model to the watched
+    path triggers a live swap; a torn publish (fault-injected) is
+    rejected once and serving continues on the last good weights."""
+    from cxxnet_tpu.nnet import checkpoint
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    fault.clear()
+    tr = make_trainer()
+    tr_new = make_trainer("seed = 99\n")
+    saved = str(tmp_path / "0001.model")
+    watch = str(tmp_path / "publish.model")
+    _save_checkpoint(tr_new, saved)
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 swap_watch=watch, swap_poll_ms=20.0)
+    srv.warmup()
+    srv.start()
+    rng = np.random.RandomState(13)
+    probe = req(rng, 4)
+    try:
+        old = srv.submit(probe).result(timeout=60)
+        checkpoint.publish_model(saved, watch)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if srv.stats()["swaps"] >= 1:
+                break
+            time.sleep(0.05)
+        assert srv.stats()["swaps"] == 1, "watcher never swapped"
+        new = srv.submit(probe).result(timeout=60)
+        assert not np.array_equal(old, new)
+        # torn publish leg: the watcher validates and rejects, the
+        # new weights keep serving
+        fault.inject("swap_torn_checkpoint", "corrupt")
+        checkpoint.publish_model(saved, watch)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if srv.stats()["swap_rejected"] >= 1:
+                break
+            time.sleep(0.05)
+        assert srv.stats()["swap_rejected"] == 1, \
+            "torn publish never rejected"
+        still = srv.submit(probe).result(timeout=60)
+        assert np.array_equal(new, still)
+        stats = srv.stats()
+        assert stats["errors"] == 0 and stats["swaps"] == 1
+    finally:
+        fault.clear()
+        srv.stop()
+
+
+def test_serve_front_keys_registered_in_schema():
+    from cxxnet_tpu.analysis import schema
+    reg = schema.get_registry()
+    for key in ("serve_port", "serve_queue_limit",
+                "serve_deadline_ms", "serve_shed_clear_ms",
+                "swap_watch", "swap_poll_ms", "publish_model"):
+        assert reg.recognizes(key), key
+    assert schema.suggest("serve_queue_limitt") == "serve_queue_limit"
+    assert schema.suggest("swap_watchh") == "swap_watch"
+
+
+def test_no_http_thread_unless_armed(trainer):
+    """Byte-parity guard: a Server without serve_port/metrics_port
+    spawns no HTTP listener thread and imports no HTTP plane."""
+    srv = Server(trainer, max_batch=8, max_wait_ms=1.0, replicas=1)
+    srv.warmup()
+    srv.start()
+    try:
+        assert srv.metrics_server is None
+        assert not [t for t in threading.enumerate()
+                    if t.name == "telemetry-http"]
+    finally:
+        srv.stop()
