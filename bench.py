@@ -1106,6 +1106,77 @@ def _bench_serve_storm(ctx) -> dict:
         return {"serve_storm_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_canary_swap(ctx) -> dict:
+    """Cost of a canaried rollout (docs/SERVING.md "Canary runbook"):
+    requests served WHILE a canary is active go through the same
+    warmed bucket executables as steady state (the candidate is just
+    a second params argument binding), so `serve_canary_p99_ms`
+    should sit on top of the uncontended serve p99 - a gap means the
+    judge's shadow dispatches or the routing split are stealing
+    device time. `serve_canary_promote_lag_ms` is the judge's
+    overhead beyond the configured window: how long after the window
+    closes the promote actually lands. The candidate is the
+    incumbent's own checkpoint (agreement 1.0 - promote guaranteed);
+    this prices the machinery, not the model. Disable with
+    CXN_BENCH_SERVE_CANARY=0."""
+    if os.environ.get("CXN_BENCH_SERVE_CANARY") == "0":
+        return {}
+    try:
+        import tempfile
+
+        from cxxnet_tpu.serve import Server
+        tr = ctx.trainer
+        batch = ctx.batch
+        rng = np.random.RandomState(27)
+        data, _ = _alexnet_batch(rng, batch)
+        mb = min(batch,
+                 int(os.environ.get("CXN_BENCH_SERVE_MAXB", "32")))
+        window_s = 0.6
+        srv = Server(tr, max_batch=mb, max_wait_ms=2.0, replicas=2,
+                     canary_frac=0.5, canary_window=window_s)
+        srv.warmup()
+        n_warm = srv.executable_cache_size()
+        srv.start()
+        with tempfile.TemporaryDirectory(
+                prefix="bench_canary_") as d:
+            ck = os.path.join(d, "cand.model")
+            with open(ck, "wb") as f:
+                tr.save_model(f)
+            t_pub = time.perf_counter()
+            if not srv.swap_to(ck):
+                srv.stop()
+                return {"serve_canary_error": "swap_to refused"}
+            cycle = [1, mb // 2, mb, 3, mb // 4 or 1, 7]
+            lat_ms = []
+            # closed-loop probes for the whole canary lifetime: every
+            # request lands on one side of the split or the other
+            while srv.stats()["canary_active"]:
+                n = max(1, min(int(rng.choice(cycle)), mb))
+                t_sub = time.perf_counter()
+                srv.submit(data[:n]).result(timeout=600)
+                lat_ms.append((time.perf_counter() - t_sub) * 1e3)
+            promote_lag_ms = (time.perf_counter() - t_pub
+                              - window_s) * 1e3
+            stats = srv.stats()
+            flat = srv.executable_cache_size() == n_warm
+            srv.stop()
+        if stats["canary_promoted"] != 1:
+            return {"serve_canary_error":
+                    f"verdict was not promote: {stats}"}
+        lat_ms.sort()
+        p99 = lat_ms[min(len(lat_ms) - 1,
+                         int(0.99 * len(lat_ms)))] if lat_ms else 0.0
+        return {
+            "serve_canary_p99_ms": round(p99, 2),
+            "serve_canary_promote_lag_ms": round(promote_lag_ms, 1),
+            "serve_canary_requests": stats["canary_requests"],
+            "serve_canary_probes": len(lat_ms),
+            "serve_canary_cache_flat": flat,
+        }
+    except Exception as e:  # noqa: BLE001 - never kill the headline
+        return {"serve_canary_error": f"{type(e).__name__}: {e}"}
+
+
 _BN_CONVNET_CONF = """
 netconfig=start
 layer[+1:c1] = conv:c1
@@ -1615,6 +1686,8 @@ _MEASUREMENTS = (
     ("zero", _bench_zero, "CXN_BENCH_ZERO", 150, "h2d"),
     ("serve", _bench_serve, "CXN_BENCH_SERVE", 150, "h2d"),
     ("serve_storm", _bench_serve_storm, "CXN_BENCH_SERVE_STORM", 150,
+     "h2d"),
+    ("canary_swap", _bench_canary_swap, "CXN_BENCH_SERVE_CANARY", 150,
      "h2d"),
     ("fold", _bench_fold, "CXN_BENCH_FOLD", 150, "h2d"),
     ("int8", _bench_int8, "CXN_BENCH_INT8", 150, "h2d"),

@@ -591,10 +591,14 @@ def _scenario_serve_storm(aud: LockAuditor,
     fan-out all exercised); every future must resolve. The production
     front rides along: the HTTP ingress thread answers a /predict
     POST mid-storm, the admission check runs with a (non-binding)
-    queue_limit armed, and the checkpoint watcher thread picks up a
-    published checkpoint and hot-swaps it live - so the new
-    ingress/shed/swap lock interactions land in the audited graph."""
+    queue_limit armed, the connection accept gate is saturated and
+    released (serve_max_conns armed - the gate's own lock joins the
+    graph), and the checkpoint watcher thread picks up a published
+    checkpoint which the canary judge thread scores and promotes
+    live - so the ingress/shed/swap/canary lock interactions all land
+    in the audited graph."""
     import json as _json
+    import socket as _socket
     import tempfile
     import urllib.request
 
@@ -610,11 +614,14 @@ def _scenario_serve_storm(aud: LockAuditor,
     watch = os.path.join(tmpd, "publish.model")
     srv = Server(trainer, max_batch=8, max_wait_ms=2.0, replicas=2,
                  http_port=0, queue_limit=100000,
-                 swap_watch=watch, swap_poll_ms=20.0)
+                 swap_watch=watch, swap_poll_ms=20.0,
+                 canary_frac=0.5, canary_window=0.8, max_conns=2)
+    srv.shed_clear_ms = 100.0
     rows_sent = 0
     errors: List[str] = []
     results: List[int] = []
     http_status = 0
+    gate_rejected = False
     res_lock = threading.Lock()
     srv.warmup()
     with srv:
@@ -641,30 +648,85 @@ def _scenario_serve_storm(aud: LockAuditor,
                    for s in (11, 22, 33)]
         for t in threads:
             t.start()
-        # mid-storm: one /predict POST through the ingress thread and
-        # one checkpoint published to the watched path (same weights -
-        # the full validate/stage/flip path is what the audit wants)
-        body = _json.dumps({"data": [[0.1] * 36]}).encode()
+        # mid-storm: saturate the accept gate (max_conns=2) with two
+        # held raw connections - a third must get the gate's raw 503 -
+        # then release; the gate lock's enter/leave/recover traffic
+        # joins the audited graph under real load
+        port = srv.metrics_server.port
+        held = []
         try:
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{srv.metrics_server.port}/predict",
-                data=body,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=60) as r:
-                http_status = r.status
+            for _ in range(2):
+                h = _socket.create_connection(
+                    ("127.0.0.1", port), timeout=10)
+                h.sendall(b"GET /healthz HTTP/1.0\r\nX-Hold")
+                held.append(h)
+            time.sleep(0.2)
+            probe = _socket.create_connection(
+                ("127.0.0.1", port), timeout=10)
+            probe.sendall(b"GET /healthz HTTP/1.0\r\n\r\n")
+            probe.settimeout(10.0)
+            buf = b""
+            try:
+                while True:
+                    chunk = probe.recv(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+            except OSError:
+                pass
+            probe.close()
+            gate_rejected = b"503" in buf.split(b"\r\n")[0]
         except Exception as e:  # noqa: BLE001 - reported below
             with res_lock:
-                errors.append(f"http: {type(e).__name__}: {e}")
+                errors.append(f"gate: {type(e).__name__}: {e}")
+        finally:
+            for h in held:
+                h.close()
+        # one /predict POST through the ingress thread (retried: the
+        # just-released gate slots may take a beat to free) and one
+        # checkpoint published to the watched path (same weights -
+        # the full validate/stage/canary/promote path is what the
+        # audit wants)
+        body = _json.dumps({"data": [[0.1] * 36]}).encode()
+        for attempt in range(5):
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict",
+                    data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    http_status = r.status
+                break
+            except Exception as e:  # noqa: BLE001 - reported below
+                if attempt == 4:
+                    with res_lock:
+                        errors.append(
+                            f"http: {type(e).__name__}: {e}")
+                time.sleep(0.3)
         _ckpt.publish_model(saved, watch)
         for t in threads:
             t.join(timeout=120.0)
+        # a trickle keeps canary traffic + shadow samples flowing
+        # until the judge reaches its verdict at the window
+        rng = np.random.RandomState(44)
         deadline = time.monotonic() + 15.0
         while time.monotonic() < deadline:
-            if srv.stats()["swaps"] >= 1:
+            if srv.stats()["canary_promoted"] >= 1:
+                break
+            data = rng.rand(4, 1, 1, 36).astype(np.float32)
+            try:
+                srv.submit(data).result(timeout=60.0)
+            except Exception as e:  # noqa: BLE001 - reported below
+                with res_lock:
+                    errors.append(
+                        f"trickle: {type(e).__name__}: {e}")
                 break
             time.sleep(0.02)
         alive = [t.name for t in threads if t.is_alive()]
         rows_sent = 3 * sum(_STORM_SIZES)
+        # ingress counters live on the HTTP plane: snapshot them
+        # before stop() closes it
+        conn_rejected = srv.stats().get("conn_rejected", 0)
     stats = srv.stats()
     checks = [
         _check("serve-storm", "all-submitters-done", not alive,
@@ -683,6 +745,15 @@ def _scenario_serve_storm(aud: LockAuditor,
                stats["swaps"] == 1 and stats["swap_rejected"] == 0,
                f"{stats['swaps']} swaps, "
                f"{stats['swap_rejected']} rejected"),
+        _check("serve-storm", "canary-judge-promoted",
+               stats["canary_promoted"] == 1
+               and stats["canary_rolled_back"] == 0,
+               f"{stats['canary_promoted']} promoted, "
+               f"{stats['canary_rolled_back']} rolled back"),
+        _check("serve-storm", "conn-gate-exercised",
+               gate_rejected and conn_rejected >= 1,
+               f"raw 503 seen: {gate_rejected}, "
+               f"{conn_rejected} rejected"),
     ]
     return checks
 

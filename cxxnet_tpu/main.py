@@ -1131,6 +1131,20 @@ class LearnTask:
         srv.warmup()
         telemetry.stdout("serve: warmup done, start serving")
         import collections
+        import signal
+        import threading
+        # graceful drain on SIGTERM (docs/SERVING.md "Connection
+        # limits & drain"): the handler only flips an Event - the
+        # serving loop notices it between submissions, stops feeding,
+        # resolves everything already admitted, and exits 0 with the
+        # output file complete for the rows served
+        term = threading.Event()
+        old_term = None
+        try:
+            old_term = signal.signal(
+                signal.SIGTERM, lambda signum, frame: term.set())
+        except ValueError:
+            pass  # not the main thread (embedded run): no handler
         sizes = self._serve_request_sizes()
         t0 = time.monotonic()
         # bounded in-flight window: futures resolve in submission
@@ -1150,7 +1164,7 @@ class LearnTask:
                             fo.write(f"{v:g}\n")
 
                 self.itr_pred.before_first()
-                while self.itr_pred.next():
+                while not term.is_set() and self.itr_pred.next():
                     batch = self.itr_pred.value()
                     if batch.is_sparse():
                         c, y, x = self.net_trainer.net_cfg.input_shape
@@ -1165,7 +1179,7 @@ class LearnTask:
                                   :self.net_trainer.net_cfg
                                   .extra_data_num]]
                     lo = 0
-                    while lo < valid:
+                    while lo < valid and not term.is_set():
                         n = min(next(sizes), valid - lo)
                         try:
                             futures.append(srv.submit(
@@ -1182,9 +1196,19 @@ class LearnTask:
                             continue
                         lo += n
                         drain(max_inflight)
+                # reached on completion AND on SIGTERM: every future
+                # already admitted resolves into the output file -
+                # zero drops of admitted work either way
                 drain(0)
         finally:
-            stats = srv.stop()
+            if old_term is not None:
+                signal.signal(signal.SIGTERM, old_term)
+            if term.is_set():
+                telemetry.stdout(
+                    "serve: SIGTERM - draining queued requests")
+                stats = srv.drain()
+            else:
+                stats = srv.stop()
         dt = time.monotonic() - t0
         qps = stats["requests"] / dt if dt > 0 else 0.0
         telemetry.stdout(

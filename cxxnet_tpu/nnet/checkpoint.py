@@ -340,6 +340,7 @@ def publish_model(src_path: str, publish_path: str) -> None:
     ("corrupt") publishes a deliberately truncated, trailer-less copy
     instead, driving the swap-reject path in tests and the
     serve-http-smoke torn-checkpoint leg."""
+    import json
     t0 = time.perf_counter()
     torn = fault.fault_point("swap_torn_checkpoint") == "corrupt"
     size = os.path.getsize(src_path)
@@ -348,6 +349,16 @@ def publish_model(src_path: str, publish_path: str) -> None:
     # rest (incl. the crc trailer): the shape a non-atomic writer
     # killed mid-copy would have left behind
     budget = max(1, size // 2) if torn else size
+    # provenance sidecar FIRST (then the model copy): the watcher
+    # triggers on the model file's stat, so the published model is
+    # never observable without its metadata - swap/canary events can
+    # always name the source checkpoint they promoted or rolled back
+    with fault.atomic_writer(publish_path + ".meta", "w") as fm:
+        fm.write(json.dumps({
+            "src": os.path.abspath(src_path),
+            "bytes": budget,
+            "torn": bool(torn),
+        }, sort_keys=True))
     with open(src_path, "rb") as fi, \
             fault.atomic_writer(publish_path) as fo:
         while copied < budget:
@@ -359,3 +370,17 @@ def publish_model(src_path: str, publish_path: str) -> None:
     telemetry.event("checkpoint", op="publish", src=src_path,
                     path=publish_path, bytes=copied, torn=torn,
                     secs=round(time.perf_counter() - t0, 4))
+
+
+def read_publish_meta(publish_path: str):
+    """Provenance sidecar of a published checkpoint (written by
+    publish_model next to the model file), or None when absent or
+    unparseable - pre-sidecar publishes and hand-copied files stay
+    swappable."""
+    import json
+    try:
+        with open(publish_path + ".meta", "r") as fi:
+            meta = json.load(fi)
+        return meta if isinstance(meta, dict) else None
+    except (OSError, ValueError):
+        return None

@@ -202,6 +202,26 @@ class NetTrainer:
         # checksummed) checkpoint; "" = off
         self.swap_watch = ""
         self.swap_poll_ms = 200.0
+        # canaried rollout (docs/SERVING.md "Canary runbook"): with
+        # swap_canary_frac in (0, 1] a validated new checkpoint is
+        # STAGED, not promoted - that fraction of requests (hashed by
+        # trace id) serves the candidate params while a judge thread
+        # scores it for swap_canary_window seconds (error/deadline
+        # rates vs incumbent + shadow-pair divergence), then
+        # auto-promotes or auto-rolls-back. 0 = off (PR-16 immediate
+        # swap, byte-identical behavior)
+        self.swap_canary_frac = 0.0
+        self.swap_canary_window = 10.0
+        # connection-level ingress hardening (docs/SERVING.md
+        # "Connection limits & drain"; all 0 = off, the PR-16
+        # listener): per-connection read deadline so a slow-loris
+        # client cannot pin a listener thread, a hard cap on
+        # concurrent connections (503 + Retry-After past it, own
+        # `serve_conns` health source), and a max request-body size
+        # (413 past it, rejected before the body is read)
+        self.serve_conn_timeout_ms = 0.0
+        self.serve_max_conns = 0
+        self.serve_max_body_bytes = 0
         # explicit serving bucket ladder (serve_bucket_ladder = comma
         # ints; None = power-of-two default): Server(trainer) reads
         # it; a tuning-cache serve_ladder fills it as a default under
@@ -368,6 +388,26 @@ class NetTrainer:
             if float(val) <= 0:
                 raise ValueError("swap_poll_ms must be > 0")
             self.swap_poll_ms = float(val)
+        if name == "swap_canary_frac":
+            if not 0.0 <= float(val) <= 1.0:
+                raise ValueError("swap_canary_frac must be in [0, 1]")
+            self.swap_canary_frac = float(val)
+        if name == "swap_canary_window":
+            if float(val) <= 0:
+                raise ValueError("swap_canary_window must be > 0")
+            self.swap_canary_window = float(val)
+        if name == "serve_conn_timeout_ms":
+            if float(val) < 0:
+                raise ValueError("serve_conn_timeout_ms must be >= 0")
+            self.serve_conn_timeout_ms = float(val)
+        if name == "serve_max_conns":
+            if int(val) < 0:
+                raise ValueError("serve_max_conns must be >= 0")
+            self.serve_max_conns = int(val)
+        if name == "serve_max_body_bytes":
+            if int(val) < 0:
+                raise ValueError("serve_max_body_bytes must be >= 0")
+            self.serve_max_body_bytes = int(val)
         if name == "serve_bucket_ladder":
             rungs = [int(t) for t in val.split(",") if t.strip()]
             if (not rungs or any(r < 1 for r in rungs)

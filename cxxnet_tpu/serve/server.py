@@ -72,7 +72,33 @@ HTTP" + "Hot-swap runbook"):
   switches between batches under `_swap_lock`; in-flight dispatches
   already bound the old params and finish on the old weights, no
   request drops. A torn/corrupt file is rejected (`swap.rejected`
-  event) and the old weights keep serving.
+  event) and the old weights keep serving;
+- **canaried rollout with automatic rollback** (`swap_canary_frac=`,
+  docs/SERVING.md "Canary runbook"): a validated new checkpoint is
+  STAGED as a candidate params slot instead of promoted - a
+  deterministic fraction of requests (hash of the trace id, so split
+  parts stay coherent) binds the candidate while the rest keep the
+  incumbent, both through the SAME warmed bucket executables (params
+  are jit arguments; the canary is a second argument binding - zero
+  recompiles, `executable_cache_size()` stays flat). A judge thread
+  scores the candidate over `swap_canary_window` seconds
+  (error/deadline rates vs incumbent + shadow pairs: the same live
+  rows dispatched through both param sets, compared argmax/allclose)
+  and either auto-promotes (`swap` op=promoted) or auto-rolls-back
+  (`swap` op=rolled_back; the incumbent is bitwise-untouched and the
+  watcher quarantines the file exactly like a torn checkpoint - the
+  pre-attempt stat record means it is never retried until
+  republished);
+- **hardened ingress + graceful drain** (docs/SERVING.md "Connection
+  limits & drain"): `serve_conn_timeout_ms`/`serve_max_conns`/
+  `serve_max_body_bytes` plumb to the listener (telemetry/http.py) -
+  per-connection read deadlines so a slow-loris client cannot pin a
+  listener thread, an accept gate answering 503 + Retry-After past
+  the connection cap (own `serve_conns` health source with the same
+  hysteretic recovery as shedding), and a 413 for bloated bodies
+  before a byte of them is read. `drain()` (SIGTERM in `task=serve`)
+  stops admission, flips /healthz to a draining verdict, resolves
+  everything queued with zero drops, then stops.
 """
 
 from __future__ import annotations
@@ -82,6 +108,7 @@ import itertools
 import os
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -89,6 +116,21 @@ import numpy as np
 from cxxnet_tpu import telemetry
 from cxxnet_tpu.telemetry.flight import fingerprint as exec_fingerprint
 from cxxnet_tpu.utils import fault
+
+# Retry-After advice when the drain-rate EWMA has no samples yet (a
+# cold or just-restarted Server has dispatched nothing): the
+# documented default the 429 header carries instead of an estimate
+# derived from uninitialized state (docs/SERVING.md)
+RETRY_AFTER_COLD_S = 1.0
+
+
+def _trace_side(trace: str, frac: float) -> int:
+    """Deterministic canary routing (docs/SERVING.md "Canary
+    runbook"): hash of the request trace id against the traffic
+    fraction - 1 = candidate, 0 = incumbent. Keyed on the trace so
+    every split part of an oversize request lands on the same weight
+    generation, and a retried trace routes the same way."""
+    return 1 if zlib.crc32(trace.encode()) % 10000 < frac * 10000 else 0
 
 
 class QueueFullError(RuntimeError):
@@ -257,9 +299,42 @@ class _JoinedFuture:
         return np.concatenate(out, axis=0)
 
 
+class _Canary:
+    """A staged candidate weight generation under judgment
+    (docs/SERVING.md "Canary runbook"). Every mutable field moves
+    under the owning Server's `_swap_lock`; the judge thread snapshots
+    under the lock and dispatches shadow pairs OUTSIDE it (GL015)."""
+
+    __slots__ = ("params", "path", "epoch", "frac", "t0", "n_req",
+                 "n_err", "n_exp", "shadow", "shadow_done",
+                 "provenance")
+
+    def __init__(self, params, path: str, epoch: int,
+                 frac: float) -> None:
+        self.params = params
+        self.path = path
+        self.epoch = epoch
+        self.frac = frac
+        self.t0 = time.monotonic()
+        # per-side accounting over the judging window, indexed
+        # [incumbent, candidate]: dispatched requests, dispatch
+        # errors, deadline expiries - the judge's rate comparison
+        self.n_req = [0, 0]
+        self.n_err = [0, 0]
+        self.n_exp = [0, 0]
+        # sampled live request rows pending a shadow comparison
+        # ((data, extras) copies; capped small - a sample, not a tap)
+        self.shadow: List[Tuple[np.ndarray, List[np.ndarray]]] = []
+        self.shadow_done = 0
+        # publish_model's sidecar metadata (src path etc.), riding
+        # the promoted/rolled_back events for provenance
+        self.provenance: Dict[str, Any] = {}
+
+
 class _WorkItem:
     __slots__ = ("data", "extras", "n", "t_submit", "future",
-                 "trace", "part", "nparts", "t_collect", "deadline")
+                 "trace", "part", "nparts", "t_collect", "deadline",
+                 "side")
 
     def __init__(self, data, extras, t_submit, trace="",
                  part=0, nparts=1, deadline=0.0) -> None:
@@ -281,6 +356,10 @@ class _WorkItem:
         self.part = part
         self.nparts = nparts
         self.t_collect = 0.0
+        # canary routing side (0 = incumbent, 1 = candidate), stamped
+        # at queue-pop from the trace hash while a canary is active;
+        # a batch only ever coalesces items of one side
+        self.side = 0
 
 
 class Server:
@@ -306,7 +385,12 @@ class Server:
                  queue_limit: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  swap_watch: Optional[str] = None,
-                 swap_poll_ms: Optional[float] = None) -> None:
+                 swap_poll_ms: Optional[float] = None,
+                 canary_frac: Optional[float] = None,
+                 canary_window: Optional[float] = None,
+                 conn_timeout_ms: Optional[float] = None,
+                 max_conns: Optional[int] = None,
+                 max_body_bytes: Optional[int] = None) -> None:
         import jax
         if trainer.state is None:
             raise RuntimeError(
@@ -435,6 +519,39 @@ class Server:
         self._swap_thread: Optional[threading.Thread] = None
         # watcher shutdown signal (checked each poll tick)
         self._swap_stop = threading.Event()
+        # canaried rollout (docs/SERVING.md "Canary runbook"): with
+        # canary_frac in (0, 1] a validated checkpoint stages as a
+        # CANDIDATE slot instead of promoting, judged for
+        # canary_window seconds. 0 = off: swap_to flips immediately,
+        # no judge thread ever spawns (unarmed byte-parity)
+        self.canary_frac = float(
+            getattr(trainer, "swap_canary_frac", 0.0)
+            if canary_frac is None else canary_frac)
+        if not 0.0 <= self.canary_frac <= 1.0:
+            raise ValueError("swap_canary_frac must be in [0, 1]")
+        self.canary_window = float(
+            getattr(trainer, "swap_canary_window", 10.0)
+            if canary_window is None else canary_window)
+        if self.canary_window <= 0:
+            raise ValueError("swap_canary_window must be > 0")
+        # the candidate under judgment (None = no canary in flight)
+        # guarded-by: self._swap_lock
+        self._canary: Optional[_Canary] = None
+        self._canary_thread: Optional[threading.Thread] = None
+        # judge shutdown signal: set by stop(), read each judge tick
+        self._canary_stop = threading.Event()
+        # connection-level ingress limits (enforced by the listener -
+        # telemetry/http.py; configured here so the serve_* fallback
+        # chain stays uniform). All 0 = off, the plain PR-16 listener.
+        self.conn_timeout_ms = float(
+            getattr(trainer, "serve_conn_timeout_ms", 0.0)
+            if conn_timeout_ms is None else conn_timeout_ms)
+        self.max_conns = int(
+            getattr(trainer, "serve_max_conns", 0)
+            if max_conns is None else max_conns)
+        self.max_body_bytes = int(
+            getattr(trainer, "serve_max_body_bytes", 0)
+            if max_body_bytes is None else max_body_bytes)
         # last (mtime_ns, size) the watcher acted on - recorded even
         # for a REJECTED file so a torn checkpoint is skipped once,
         # not re-validated in a hot loop
@@ -465,6 +582,12 @@ class Server:
         self._n_swaps = 0
         # guarded-by: self._lock
         self._n_swap_rejected = 0
+        # guarded-by: self._lock
+        self._n_canary_req = 0
+        # guarded-by: self._lock
+        self._n_canary_promoted = 0
+        # guarded-by: self._lock
+        self._n_canary_rolled_back = 0
         # measured drain rate (rows/s, EWMA over dispatched batches):
         # what Retry-After is derived from
         # guarded-by: self._lock
@@ -558,7 +681,11 @@ class Server:
                 telemetry.get(), int(self.metrics_port),
                 host=self.metrics_host,
                 predict_backend=(self if self.http_port is not None
-                                 else None))
+                                 else None),
+                conn_timeout_ms=self.conn_timeout_ms,
+                max_conns=self.max_conns,
+                max_body_bytes=self.max_body_bytes,
+                conn_clear_ms=self.shed_clear_ms)
             self.metrics_server.start()
             telemetry.event("observability", op="http_start",
                             port=self.metrics_server.port,
@@ -569,6 +696,13 @@ class Server:
             # a previous start/stop cycle draining late must not read
             # a torn flag
             self._draining = False
+        with self._lock:
+            # a restarted Server serves a fresh traffic mix: the
+            # previous run's drain-rate EWMA is stale advice, so
+            # Retry-After reverts to the documented cold default
+            # until a batch dispatches (RETRY_AFTER_COLD_S)
+            self._drain_rate = 0.0
+            self._last_drain_t = 0.0
         self._started = True
         for i in range(self.replicas):
             t = threading.Thread(target=self._replica_loop,
@@ -596,6 +730,13 @@ class Server:
             self._swap_stop.set()
             self._swap_thread.join(timeout=10.0)
             self._swap_thread = None
+        if self._canary_thread is not None:
+            # an undecided canary fails SAFE at shutdown: the judge
+            # sees the stop signal and rolls back to the incumbent
+            # (promotion needs a full window's evidence)
+            self._canary_stop.set()
+            self._canary_thread.join(timeout=15.0)
+            self._canary_thread = None
         with self._cond:
             self._draining = True
             if not drain:
@@ -627,6 +768,32 @@ class Server:
         stats = self.stats()
         telemetry.event("serve", op="stop", **{
             k: v for k, v in stats.items() if not isinstance(v, dict)})
+        return stats
+
+    def drain(self) -> Dict[str, Any]:
+        """Graceful shutdown (docs/SERVING.md "Connection limits &
+        drain"; `task=serve` runs this on SIGTERM): stop admitting -
+        new submits raise and /predict answers 503 - flip /healthz to
+        a `serve_drain` 503 so the LB rotates this replica out,
+        resolve EVERYTHING already queued (zero drops: the replicas
+        keep dispatching until the queue is empty), then stop.
+        Returns the final stats()."""
+        with self._cond:
+            depth = self._queued_rows
+            self._draining = True
+            self._cond.notify_all()
+        telemetry.get().health.set_unhealthy(
+            "serve_drain", "draining: shutdown in progress")
+        telemetry.event("serve", op="drain_start", queue_rows=depth)
+        try:
+            stats = self.stop(drain=True)
+        finally:
+            # the listener is closed by stop(); clear the verdict so
+            # a long-lived process (or a restarted Server) does not
+            # inherit a stale draining 503
+            telemetry.get().health.clear("serve_drain")
+        telemetry.event("serve", op="drain_done", queue_rows=depth,
+                        errors=stats.get("errors"))
         return stats
 
     def __enter__(self) -> "Server":
@@ -750,13 +917,19 @@ class Server:
     def _retry_after(self, backlog_rows: int) -> float:
         """Retry-After advice for a shed request: the time the current
         backlog takes to drain at the measured (EWMA) drain rate,
-        clamped to [0.1s, 60s]. Before any batch has dispatched the
-        rate is unknown and the floor applies."""
+        clamped to [0.1s, 60s]. With no sample yet - a cold Server, or
+        one just restarted (start() resets the EWMA) - the rate is
+        unknown and the documented RETRY_AFTER_COLD_S default applies;
+        a non-finite estimate falls back the same way rather than
+        leaking garbage into the header."""
         with self._lock:
             rate = self._drain_rate
-        if rate <= 0:
-            return 1.0
-        return min(60.0, max(0.1, backlog_rows / rate))
+        if not (rate > 0.0) or not np.isfinite(rate):
+            return RETRY_AFTER_COLD_S
+        adv = backlog_rows / rate
+        if not np.isfinite(adv):
+            return RETRY_AFTER_COLD_S
+        return min(60.0, max(0.1, adv))
 
     def _maybe_recover(self) -> None:
         """Shed->healthy hysteresis: clear the `serve_shed` health
@@ -782,6 +955,13 @@ class Server:
         future Event set + registry counters need no queue state)."""
         with self._lock:
             self._n_expired += 1
+        if self.canary_frac > 0:
+            # judge evidence: attribute the expiry to the weight
+            # generation that would have served this trace
+            with self._swap_lock:
+                can = self._canary
+                if can is not None:
+                    can.n_exp[_trace_side(it.trace, can.frac)] += 1
         telemetry.inc("serve.deadline_expired")
         waited_ms = (now - it.t_submit) * 1e3
         it.future._set_error(DeadlineExpiredError(
@@ -801,7 +981,16 @@ class Server:
         stopping and drained; an empty list means "nothing live this
         round, loop again" (everything popped had expired)."""
         expired: List[_WorkItem] = []
-        items = self._collect_locked(expired)
+        frac = 0.0
+        if self.canary_frac > 0:
+            # snapshot the active canary's traffic split BEFORE taking
+            # _cond (no nested locks on the admission path); a canary
+            # resolving mid-collect is benign - the batch's side tag
+            # just routes to the incumbent at dispatch
+            with self._swap_lock:
+                if self._canary is not None:
+                    frac = self._canary.frac
+        items = self._collect_locked(expired, frac)
         if expired:
             now = time.monotonic()
             for it in expired:
@@ -811,7 +1000,7 @@ class Server:
         return items
 
     def _collect_locked(
-            self, expired: List[_WorkItem]
+            self, expired: List[_WorkItem], frac: float = 0.0
     ) -> Optional[List[_WorkItem]]:
         with self._cond:
             first = None
@@ -851,6 +1040,8 @@ class Server:
             # coalesce stamp: end of this item's queue phase (request
             # tracing's queue-vs-device cut)
             first.t_collect = time.monotonic()
+            if frac > 0.0:
+                first.side = _trace_side(first.trace, frac)
             items = [first]
             total = first.n
             deadline = first.t_submit + self.max_wait_ms / 1e3
@@ -862,6 +1053,13 @@ class Server:
                         self._queued_rows -= head.n
                         expired.append(head)
                         continue
+                    if frac > 0.0:
+                        head.side = _trace_side(head.trace, frac)
+                        if head.side != first.side:
+                            # a batch binds ONE weight generation:
+                            # ship what we have, the head opens the
+                            # other side's batch next round
+                            break
                     if head.n <= self.max_batch - total:
                         it = self._queue.popleft()
                         self._queued_rows -= it.n
@@ -917,9 +1115,33 @@ class Server:
             # dispatch itself runs outside the lock (GL015 - never
             # hold a lock across a jax boundary). An in-flight batch
             # that snapshotted before a swap finishes on old weights.
+            # A canary batch (side=1) binds the staged candidate
+            # params instead - same fn, same warmed executables, the
+            # candidate is just a second argument binding.
+            side = items[0].side
+            routed = 0
             with self._swap_lock:
                 fn = self._fn
-                params = self.trainer.state["params"]
+                can = self._canary
+                if can is not None and side == 1:
+                    params = can.params
+                    routed = len(items)
+                else:
+                    side = 0
+                    params = self.trainer.state["params"]
+                if can is not None:
+                    can.n_req[side] += len(items)
+                    if side == 0 and len(can.shadow) < 4:
+                        # sample incumbent rows for the judge's shadow
+                        # comparison (same rows through BOTH param
+                        # sets, compared argmax/allclose)
+                        can.shadow.append(
+                            (items[0].data.copy(),
+                             [e.copy() for e in items[0].extras]))
+            if routed:
+                with self._lock:
+                    self._n_canary_req += routed
+                telemetry.inc("serve.canary_requests", routed)
             gdata, gextras = self.trainer.stage_infer_rows(data, extras)
             out = fn(params, gdata, gextras)
             rows = distributed.fetch_local(out)
@@ -1001,6 +1223,13 @@ class Server:
             except BaseException as e:  # noqa: BLE001 - delivered via futures
                 with self._lock:
                     self._n_errors += 1
+                if self.canary_frac > 0:
+                    # judge evidence: bill the failed dispatch to the
+                    # weight generation the batch was bound to
+                    with self._swap_lock:
+                        can = self._canary
+                        if can is not None:
+                            can.n_err[items[0].side] += 1
                 telemetry.inc("serve.errors")
                 telemetry.stderr(
                     f"serve: dispatch failed: {type(e).__name__}: {e}\n",
@@ -1072,7 +1301,6 @@ class Server:
         torn/corrupt/mismatched checkpoint emits `swap` op=rejected
         and the old weights keep serving (False)."""
         from cxxnet_tpu.nnet import checkpoint
-        from cxxnet_tpu.parallel import distributed
         t0 = time.perf_counter()
         blob = None
         reason = checkpoint.validate_file(path)
@@ -1095,18 +1323,40 @@ class Server:
                 event_kind="swap", op="rejected", path=path,
                 reason=reason)
             return False
-        # stage the new weights at the stored sharded layout (the
-        # same put_global_full landing set_weight uses) BEFORE taking
-        # the swap lock - device_put is a dispatch boundary and must
-        # never run under a lock (GL015 / the runtime lock audit)
-        cur = self.trainer.state["params"]
-        pstore = self.trainer._params_store_shard
-        staged = {
-            lk: {pn: distributed.put_global_full(
-                np.ascontiguousarray(blob["params"][lk][pn]),
-                pstore[lk][pn])
-                for pn in cur[lk]}
-            for lk in cur}
+        if self.canary_frac > 0:
+            calibrated = (self.trainer._fold_stats is not None
+                          or self.trainer._quant_stats is not None)
+            if calibrated:
+                # frozen fold/quant calibration means applying this
+                # checkpoint rewarms new executables - incumbent and
+                # candidate could not share warmed buckets, so the
+                # traffic split is impossible. Fall through to the
+                # direct (non-canaried) swap and say so.
+                telemetry.stderr(
+                    f"serve: canary bypassed for {path}: calibrated "
+                    f"passes force a rewarm, applying directly\n",
+                    event_kind="swap", op="canary_bypassed", path=path)
+            else:
+                with self._swap_lock:
+                    busy = self._canary is not None
+                if busy:
+                    with self._lock:
+                        self._n_swap_rejected += 1
+                    telemetry.inc("serve.swap_rejected")
+                    telemetry.stderr(
+                        f"serve: checkpoint swap rejected ({path}): "
+                        f"canary already in progress\n",
+                        event_kind="swap", op="rejected", path=path,
+                        reason="canary already in progress")
+                    return False
+                staged = self._stage_params(blob)
+                return self._start_canary(
+                    staged, path,
+                    int(blob.get("epoch", self.trainer.epoch)))
+        # stage the new weights at the stored sharded layout BEFORE
+        # taking the swap lock - device_put is a dispatch boundary and
+        # must never run under a lock (GL015 / the runtime lock audit)
+        staged = self._stage_params(blob)
         with self._swap_lock:
             self.trainer.state["params"] = staged
             self.trainer.epoch = int(blob.get("epoch",
@@ -1133,6 +1383,238 @@ class Server:
                         epoch=self.trainer.epoch, rewarmed=rewarmed,
                         secs=round(time.perf_counter() - t0, 4))
         return True
+
+    def _stage_params(self, blob: Dict[str, Any]) -> Dict[str, Any]:
+        """Stage a validated checkpoint's params to device at the
+        stored sharded layout (the same put_global_full landing
+        set_weight uses). Runs OUTSIDE any lock - device_put is a
+        dispatch boundary and must never run under a lock (GL015 /
+        the runtime lock audit)."""
+        from cxxnet_tpu.parallel import distributed
+        cur = self.trainer.state["params"]
+        pstore = self.trainer._params_store_shard
+        return {
+            lk: {pn: distributed.put_global_full(
+                np.ascontiguousarray(blob["params"][lk][pn]),
+                pstore[lk][pn])
+                for pn in cur[lk]}
+            for lk in cur}
+
+    # -- canaried rollout --------------------------------------------------
+    def _start_canary(self, staged, path: str, epoch: int) -> bool:
+        """Install a validated, device-staged candidate as the canary
+        (docs/SERVING.md "Canary runbook"): a swap_canary_frac slice
+        of traffic (deterministic on the trace id, so oversize-split
+        parts stay coherent) binds the candidate params at dispatch
+        while the rest keeps the incumbent - through the SAME warmed
+        bucket executables, zero recompiles. A judge thread scores
+        the candidate over swap_canary_window seconds and either
+        promotes it (swap op=promoted) or rolls it back
+        (op=rolled_back, incumbent bitwise-untouched)."""
+        from cxxnet_tpu.nnet import checkpoint
+        can = _Canary(staged, path, epoch, self.canary_frac)
+        can.provenance = checkpoint.read_publish_meta(path) or {}
+        with self._swap_lock:
+            if self._canary is not None:
+                # raced with another swap_to: first canary wins, this
+                # candidate is dropped (the watcher already recorded
+                # the file's stat, so it is quarantined like a reject)
+                return False
+            self._canary = can
+        # one judge per canary: the previous judge (if any) exited
+        # when its canary resolved, so join is immediate
+        if self._canary_thread is not None:
+            self._canary_thread.join(timeout=15.0)
+        self._canary_stop.clear()
+        self._canary_thread = threading.Thread(
+            target=self._canary_judge_loop, args=(can,),
+            name="serve-canary-judge", daemon=True)
+        self._canary_thread.start()
+        telemetry.event(
+            "swap", op="canary_started", path=path, epoch=epoch,
+            frac=can.frac, window_s=self.canary_window,
+            src=str(can.provenance.get("src", "")))
+        return True
+
+    def _canary_judge_loop(self, can: "_Canary") -> None:
+        """Judge thread: periodically score the canary against the
+        incumbent until the window closes, then promote or roll back.
+        ANY judge failure rolls back - a broken judge must fail safe
+        to the incumbent (the canary_judge_error fault point proves
+        it)."""
+        try:
+            fault.fault_point("canary_judge_error")
+            deadline = can.t0 + self.canary_window
+            while True:
+                wait_s = min(0.05, max(0.0, deadline - time.monotonic()))
+                if self._canary_stop.wait(wait_s):
+                    # server stopping before the window closed: the
+                    # candidate was never promoted, drop it
+                    self._canary_rollback(
+                        can, "server stopping before verdict")
+                    return
+                verdict = self._canary_check(can)
+                if verdict is not None:
+                    self._canary_rollback(can, verdict)
+                    return
+                if time.monotonic() >= deadline:
+                    break
+            verdict = self._canary_check(can, final=True)
+            if verdict is not None:
+                self._canary_rollback(can, verdict)
+            else:
+                self._canary_promote(can)
+        except BaseException as e:  # noqa: BLE001 - fail safe to incumbent
+            self._canary_rollback(
+                can, f"judge error: {type(e).__name__}: {e}")
+
+    def _canary_check(self, can: "_Canary",
+                      final: bool = False) -> Optional[str]:
+        """One judge round. Returns a rollback reason, or None when
+        the canary still looks healthy. Evidence: (a) shadow pairs -
+        the same sampled rows dispatched through BOTH param sets and
+        compared (candidate non-finite where the incumbent is finite,
+        or argmax agreement below 0.5, is a fail); (b) error/deadline
+        rates - candidate
+        worse than incumbent with at least one bad event is a fail.
+        On the final round with zero organic evidence, a synthetic
+        zeros batch checks the candidate at least produces finite
+        output."""
+        with self._swap_lock:
+            if self._canary is not can:
+                return None
+            fn = self._fn
+            inc_params = self.trainer.state["params"]
+            cand_params = can.params
+            sample = can.shadow.pop() if can.shadow else None
+            shadow_done = can.shadow_done
+            n_req = list(can.n_req)
+            bad = [can.n_err[0] + can.n_exp[0],
+                   can.n_err[1] + can.n_exp[1]]
+        if sample is not None:
+            reason = self._shadow_divergence(
+                fn, inc_params, cand_params, sample[0], sample[1])
+            with self._swap_lock:
+                can.shadow_done += 1
+            if reason is not None:
+                return reason
+        elif final and shadow_done == 0:
+            # no organic traffic reached the incumbent during the
+            # window: synthesize a zeros batch so the candidate is at
+            # least proven finite before promotion (argmax agreement
+            # on synthetic rows is meaningless, so skip it)
+            c, y, x = self._input_dims
+            data = np.zeros((1, c, y, x), np.float32)
+            extras = [np.zeros((1, d), np.float32)
+                      for d in self._extra_dims]
+            reason = self._shadow_divergence(
+                fn, inc_params, cand_params, data, extras,
+                check_agree=False)
+            if reason is not None:
+                return reason
+        if bad[1] > 0:
+            rate = [bad[s] / max(n_req[s], 1) for s in (0, 1)]
+            if rate[1] > rate[0]:
+                return (f"candidate error/deadline rate "
+                        f"{rate[1]:.4f} > incumbent {rate[0]:.4f} "
+                        f"({bad[1]}/{n_req[1]} vs "
+                        f"{bad[0]}/{n_req[0]})")
+        return None
+
+    def _shadow_divergence(self, fn, inc_params, cand_params, data,
+                           extras, check_agree: bool = True
+                           ) -> Optional[str]:
+        """Dispatch the same rows through incumbent and candidate
+        params (same warmed bucket executables - the rows are padded
+        to a covering bucket, so the executable cache stays flat) and
+        compare. Returns a rollback reason or None."""
+        from cxxnet_tpu.parallel import distributed
+        n = int(data.shape[0])
+        bucket = next((b for b in self.buckets if b >= n),
+                      self.buckets[-1])
+        if n > bucket:
+            data, extras = data[:bucket], [e[:bucket] for e in extras]
+            n = bucket
+        if bucket > n:
+            pad = bucket - n
+            data = np.concatenate(
+                [data, np.zeros((pad,) + data.shape[1:], data.dtype)],
+                axis=0)
+            extras = [np.concatenate(
+                [e, np.zeros((pad,) + e.shape[1:], e.dtype)], axis=0)
+                for e in extras]
+        gdata, gextras = self.trainer.stage_infer_rows(data, extras)
+        out_inc = distributed.fetch_local(
+            fn(inc_params, gdata, gextras)).reshape(bucket, -1)[:n]
+        out_cand = distributed.fetch_local(
+            fn(cand_params, gdata, gextras)).reshape(bucket, -1)[:n]
+        if fault.fault_point("canary_divergence") == "corrupt":
+            # sabotage: poison the candidate's answers so the
+            # divergence check trips (rollback-path drills)
+            out_cand = out_cand + np.nan
+        # the judge scores RELATIVE health: a candidate is only
+        # penalized for non-finite outputs at positions where the
+        # incumbent was finite (an incumbent that already emits NaN -
+        # e.g. a diverged trainer - must not veto its own checkpoint)
+        cand_bad = ~np.isfinite(out_cand)
+        if bool(np.any(cand_bad & np.isfinite(out_inc))):
+            return ("candidate produced non-finite outputs where "
+                    "the incumbent was finite")
+        agree = None
+        if check_agree:
+            agree = float(np.mean(
+                predictions_from_rows(out_cand)
+                == predictions_from_rows(out_inc)))
+        telemetry.event(
+            "swap", op="canary_shadow", rows=n,
+            agree=(None if agree is None else round(agree, 4)),
+            allclose=bool(np.allclose(out_cand, out_inc,
+                                      rtol=1e-3, atol=1e-5)))
+        if agree is not None and agree < 0.5:
+            return (f"candidate argmax agreement {agree:.2f} < 0.5 "
+                    f"on {n} shadow rows")
+        return None
+
+    def _canary_promote(self, can: "_Canary") -> None:
+        """The window closed clean: the candidate becomes the
+        incumbent between batches (same flip as a direct swap -
+        in-flight batches bound their params at dispatch)."""
+        with self._swap_lock:
+            if self._canary is not can:
+                return
+            self.trainer.state["params"] = can.params
+            self.trainer.epoch = can.epoch
+            self._canary = None
+        with self._lock:
+            self._n_swaps += 1
+            self._n_canary_promoted += 1
+        telemetry.inc("serve.swaps")
+        telemetry.inc("serve.canary_promoted")
+        telemetry.event(
+            "swap", op="promoted", path=can.path, epoch=can.epoch,
+            canary_requests=can.n_req[1], shadow_pairs=can.shadow_done,
+            window_s=self.canary_window,
+            src=str(can.provenance.get("src", "")))
+
+    def _canary_rollback(self, can: "_Canary", reason: str) -> None:
+        """Drop the candidate; the incumbent was never touched, so
+        rollback is just detaching the canary slot. The watcher
+        recorded the file's stat before the attempt, so the bad
+        checkpoint is quarantined (skipped once) exactly like a torn
+        file - republishing retries."""
+        with self._swap_lock:
+            if self._canary is not can:
+                return
+            self._canary = None
+        with self._lock:
+            self._n_canary_rolled_back += 1
+        telemetry.inc("serve.canary_rolled_back")
+        telemetry.stderr(
+            f"serve: canary rolled back ({can.path}): {reason}\n",
+            event_kind="swap", op="rolled_back", path=can.path,
+            reason=reason, canary_requests=can.n_req[1],
+            shadow_pairs=can.shadow_done,
+            src=str(can.provenance.get("src", "")))
 
     # -- HTTP request path -------------------------------------------------
     def handle_predict(self, body: bytes):
@@ -1232,10 +1714,20 @@ class Server:
                 "deadline_expired": self._n_expired,
                 "swaps": self._n_swaps,
                 "swap_rejected": self._n_swap_rejected,
+                "canary_requests": self._n_canary_req,
+                "canary_promoted": self._n_canary_promoted,
+                "canary_rolled_back": self._n_canary_rolled_back,
                 "drain_rows_per_s": round(self._drain_rate, 2),
                 "buckets": {b: n for b, n in self._bucket_hits.items()},
                 "request_sizes": dict(self._size_hist),
             }
+        with self._swap_lock:
+            out["canary_active"] = self._canary is not None
+        if self.metrics_server is not None:
+            ingress = getattr(self.metrics_server, "ingress_stats",
+                              None)
+            if ingress is not None:
+                out.update(ingress())
         out["queue_limit"] = self.queue_limit
         out["warmup_s"] = round(self.warmup_s, 4)
         for hist, stem in ((self._lat, "latency"),

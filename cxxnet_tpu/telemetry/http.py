@@ -37,6 +37,17 @@ With a serving backend attached (``Server(http_port=...)`` / the CLI
 HTTP"); the protocol mapping (429 + Retry-After on shed, 504 on
 deadline expiry) lives on the Server, this module is transport only.
 
+Connection-level ingress hardening (docs/SERVING.md "Connection
+limits & drain") arms with ``serve_conn_timeout_ms`` /
+``serve_max_conns`` / ``serve_max_body_bytes``: per-connection
+header/body read deadlines (a slow-loris client is cut, not
+serviced), a max-body gate (413 before the body is read), and an
+accept gate answering an immediate raw 503 + Retry-After when
+``max_conns`` handler threads are live - with its own ``serve_conns``
+health source and the same hysteretic recovery as load shedding.
+With the keys unset the plain ``ThreadingHTTPServer`` path is used
+unchanged (byte parity).
+
 Armed only by ``metrics_port=`` / ``serve_port=`` (or
 ``Server(metrics_port=...)``); with the keys unset this module is
 never imported - the CLI byte-parity contract costs nothing.
@@ -48,12 +59,14 @@ import json
 import math
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from cxxnet_tpu.telemetry.registry import (
     BucketHistogram, Counter, Gauge, Histogram)
 from cxxnet_tpu.telemetry.sink import _sanitize
+from cxxnet_tpu.utils import fault
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -197,10 +210,178 @@ def validate_exposition(text: str) -> List[str]:
     return bad
 
 
-def _make_handler(tel, predict_backend=None):
+class IngressLimits:
+    """Connection-level ingress protection shared by the accept gate
+    and the request handlers. One instance per ObservabilityServer;
+    built only when at least one of the serve_conn_timeout_ms /
+    serve_max_conns / serve_max_body_bytes keys is armed, so the
+    unarmed listener carries zero extra state."""
+
+    def __init__(self, tel, max_conns: int = 0,
+                 conn_timeout_ms: float = 0.0,
+                 max_body_bytes: int = 0, clear_ms: float = 1000.0):
+        self._tel = tel
+        self.max_conns = int(max_conns or 0)
+        t = float(conn_timeout_ms or 0.0)
+        self.conn_timeout_s = t / 1e3 if t > 0 else 0.0
+        self.max_body_bytes = int(max_body_bytes or 0)
+        self.clear_s = max(float(clear_ms or 0.0), 0.0) / 1e3
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._active = 0
+        # guarded-by: self._lock
+        self._n_rejected = 0
+        # guarded-by: self._lock
+        self._n_timeouts = 0
+        # guarded-by: self._lock
+        self._n_oversized = 0
+        # guarded-by: self._lock
+        self._last_reject_t = 0.0
+        # guarded-by: self._lock
+        self._gate_health = False
+
+    def try_enter(self) -> bool:
+        """Accept gate: called on the accept path before a handler
+        thread is spawned. False = saturated; the caller answers an
+        immediate 503 + Retry-After and closes the socket."""
+        flip = False
+        rejected = 0
+        with self._lock:
+            if 0 < self.max_conns <= self._active:
+                self._n_rejected += 1
+                self._last_reject_t = time.monotonic()
+                if not self._gate_health:
+                    self._gate_health = True
+                    flip = True
+                rejected = self._n_rejected
+                ok = False
+            else:
+                self._active += 1
+                ok = True
+        if not ok:
+            # telemetry strictly OUTSIDE the lock (the repo's lock
+            # idiom: no I/O or cross-lock calls while held)
+            self._tel.inc("serve.conn_rejected")
+            if flip:
+                self._tel.health.set_unhealthy(
+                    "serve_conns",
+                    f"connection limit saturated "
+                    f"(serve_max_conns={self.max_conns})")
+                self._tel.event("serve", op="conn_saturated",
+                                max_conns=self.max_conns,
+                                rejected=rejected)
+        return ok
+
+    def leave(self) -> None:
+        with self._lock:
+            self._active -= 1
+        self._maybe_recover()
+
+    def _maybe_recover(self) -> None:
+        """Hysteretic gate recovery (the serve_shed pattern): clear
+        the serve_conns health verdict only once occupancy fell below
+        HALF the limit AND clear_ms passed since the last rejection -
+        a gate oscillating at the limit must not flap /healthz."""
+        clear = False
+        with self._lock:
+            if (self._gate_health
+                    and self._active * 2 < max(self.max_conns, 1)
+                    and (time.monotonic() - self._last_reject_t
+                         >= self.clear_s)):
+                self._gate_health = False
+                clear = True
+        if clear:
+            self._tel.health.clear("serve_conns")
+            self._tel.event("serve", op="conn_recovered",
+                            max_conns=self.max_conns)
+
+    def note_timeout(self, phase: str) -> None:
+        """A connection was cut at the read deadline (phase: headers
+        held open vs body dribbled - the two slow-loris shapes)."""
+        with self._lock:
+            self._n_timeouts += 1
+        self._tel.inc("serve.conn_timeouts")
+        self._tel.event("serve", op="conn_timeout", phase=phase,
+                        timeout_ms=round(self.conn_timeout_s * 1e3, 1))
+
+    def note_oversized(self, n: int) -> None:
+        with self._lock:
+            self._n_oversized += 1
+        self._tel.inc("serve.conn_oversized")
+        self._tel.event("serve", op="conn_oversized", bytes=int(n),
+                        max_body_bytes=self.max_body_bytes)
+
+    def release_health(self) -> None:
+        """Listener closing: a dead socket is not 'saturated'."""
+        with self._lock:
+            held = self._gate_health
+            self._gate_health = False
+        if held:
+            self._tel.health.clear("serve_conns")
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "conn_active": self._active,
+                "conn_rejected": self._n_rejected,
+                "conn_timeouts": self._n_timeouts,
+                "conn_oversized": self._n_oversized,
+            }
+
+
+class _IngressServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with the accept gate: when max_conns
+    handler threads are live, a new connection gets a raw 503 +
+    Retry-After ON THE ACCEPT PATH - no handler thread is spawned
+    for it, so a connection flood cannot grow the thread pool past
+    the limit."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler, limits: IngressLimits):
+        self._limits = limits
+        super().__init__(addr, handler)
+
+    def process_request(self, request, client_address):
+        if not self._limits.try_enter():
+            body = b'{"error": "connection limit reached"}'
+            try:
+                # bounded write: the reject path must never block on
+                # a client that won't read
+                request.settimeout(1.0)
+                request.sendall(
+                    b"HTTP/1.0 503 Service Unavailable\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Retry-After: 1\r\n"
+                    b"Content-Length: " + str(len(body)).encode()
+                    + b"\r\n\r\n" + body)
+            except OSError:
+                pass  # client gone; the rejection still counted
+            self.shutdown_request(request)
+            return
+        super().process_request(request, client_address)
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._limits.leave()
+
+
+def _make_handler(tel, predict_backend=None, limits=None):
+    conn_timeout = (limits.conn_timeout_s
+                    if limits is not None and limits.conn_timeout_s > 0
+                    else None)
+
     class _Handler(BaseHTTPRequestHandler):
         # one scrape per GET; no keep-alive state worth protocol 1.1
         protocol_version = "HTTP/1.0"
+        # StreamRequestHandler.setup() applies this to the accepted
+        # socket: EVERY blocking read (header line, body chunk) gets
+        # the per-connection deadline, so a client holding its
+        # headers open is cut at serve_conn_timeout_ms (None = the
+        # unarmed, wait-forever stdlib default)
+        timeout = conn_timeout
 
         def _send(self, code: int, body: bytes, ctype: str,
                   headers=None) -> None:
@@ -227,13 +408,72 @@ def _make_handler(tel, predict_backend=None):
                     n = int(self.headers.get("Content-Length") or 0)
                 except ValueError:
                     n = 0
-                body = self.rfile.read(n) if n > 0 else b""
+                if (limits is not None
+                        and 0 < limits.max_body_bytes < n):
+                    # rejected BEFORE the body is read: a bloated
+                    # client pays for its own upload, not us
+                    limits.note_oversized(n)
+                    self.close_connection = True
+                    self._send(413, json.dumps({
+                        "error": "request body too large",
+                        "bytes": n,
+                        "max_body_bytes": limits.max_body_bytes,
+                    }).encode(), "application/json")
+                    return
+                if limits is None:
+                    body = self.rfile.read(n) if n > 0 else b""
+                else:
+                    body = self._read_body(n)
+                    if body is None:
+                        return  # cut at the deadline; 408 sent
                 code, headers, out = predict_backend.handle_predict(
                     body)
                 self._send(code, out, "application/json",
                            headers=headers)
             except (BrokenPipeError, ConnectionResetError):
                 pass  # caller went away mid-write; nothing to save
+
+        def _read_body(self, n: int) -> Optional[bytes]:
+            """Read the request body against the per-connection
+            deadline: chunked, so a slow-loris client dribbling
+            bytes cannot extend its stay - the ABSOLUTE deadline
+            (set when the body read starts) cuts it regardless of
+            per-read progress. Returns None when the connection was
+            cut (408 already sent, socket closing)."""
+            if n <= 0:
+                return b""
+            deadline = (time.monotonic() + limits.conn_timeout_s
+                        if limits.conn_timeout_s > 0 else None)
+            chunks: List[bytes] = []
+            got = 0
+            try:
+                while got < n:
+                    # serve_slow_client fault point (CXXNET_FAULT):
+                    # delay mode stalls this loop exactly like a
+                    # dribbling client, so the deadline cut is
+                    # testable without a real slow socket
+                    fault.fault_point("serve_slow_client")
+                    if (deadline is not None
+                            and time.monotonic() >= deadline):
+                        raise TimeoutError("body read deadline")
+                    chunk = self.rfile.read(min(n - got, 65536))
+                    if not chunk:
+                        break  # short body; json decode will 400 it
+                    chunks.append(chunk)
+                    got += len(chunk)
+            except (TimeoutError, OSError):
+                limits.note_timeout("body")
+                self.close_connection = True
+                try:
+                    self._send(408, json.dumps({
+                        "error": "request body read timed out",
+                        "timeout_ms": round(
+                            limits.conn_timeout_s * 1e3, 1),
+                    }).encode(), "application/json")
+                except OSError:
+                    pass  # client gone; the cut still counted
+                return None
+            return b"".join(chunks)
 
         def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
             path = self.path.split("?", 1)[0]
@@ -278,6 +518,15 @@ def _make_handler(tel, predict_backend=None):
             # lines too; scrapes are not run output)
             pass
 
+        def log_error(self, fmt, *args) -> None:
+            # the parent's handle_one_request absorbs a HEADER-phase
+            # socket timeout (the classic slow-loris: connect, never
+            # finish the request line) and reports it only here
+            # ("Request timed out: ..."), so this override is where
+            # that cut becomes a counted serve.conn_timeouts event
+            if limits is not None and "timed out" in str(fmt):
+                limits.note_timeout("headers")
+
     return _Handler
 
 
@@ -288,11 +537,27 @@ class ObservabilityServer:
     ``start()``, and ``close()`` shuts the socket down and joins."""
 
     def __init__(self, tel, port: int = 0, host: str = "0.0.0.0",
-                 predict_backend=None):
-        self._srv = ThreadingHTTPServer(
-            (host, int(port)),
-            _make_handler(tel, predict_backend=predict_backend))
-        self._srv.daemon_threads = True
+                 predict_backend=None, conn_timeout_ms: float = 0.0,
+                 max_conns: int = 0, max_body_bytes: int = 0,
+                 conn_clear_ms: float = 1000.0):
+        limits = None
+        if ((conn_timeout_ms or 0) > 0 or (max_conns or 0) > 0
+                or (max_body_bytes or 0) > 0):
+            limits = IngressLimits(
+                tel, max_conns=max_conns,
+                conn_timeout_ms=conn_timeout_ms,
+                max_body_bytes=max_body_bytes,
+                clear_ms=conn_clear_ms)
+        self._limits = limits
+        handler = _make_handler(tel, predict_backend=predict_backend,
+                                limits=limits)
+        if limits is not None:
+            self._srv = _IngressServer((host, int(port)), handler,
+                                       limits)
+        else:
+            # unarmed parity: the exact pre-hardening server class
+            self._srv = ThreadingHTTPServer((host, int(port)), handler)
+            self._srv.daemon_threads = True
         self.port: int = self._srv.server_address[1]
         self.host = host
         self._thread: Optional[threading.Thread] = None
@@ -305,9 +570,16 @@ class ObservabilityServer:
             self._thread.start()
         return self
 
+    def ingress_stats(self) -> Dict[str, int]:
+        """Connection-gate counters (empty dict when the ingress
+        limits are unarmed); merged into Server.stats()."""
+        return self._limits.stats() if self._limits is not None else {}
+
     def close(self) -> None:
         if self._thread is not None:
             self._srv.shutdown()
             self._thread.join(timeout=5.0)
             self._thread = None
         self._srv.server_close()
+        if self._limits is not None:
+            self._limits.release_health()

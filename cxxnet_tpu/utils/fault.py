@@ -202,7 +202,11 @@ class FaultRegistry:
     Any other mode (``corrupt``, ...) is returned to the CALLER, which
     gives each fault point site-specific sabotage: checkpoint.py
     truncates the blob being written, trainer.stage_batch NaN-poisons
-    the batch.
+    the batch, the serving canary NaN-poisons the candidate's shadow
+    outputs (``canary_divergence:corrupt``) so the rollback verdict
+    trips, and the HTTP body reader stalls mid-read
+    (``serve_slow_client:delay``) so the connection deadline cuts it.
+    The full point table lives in docs/FAULT_TOLERANCE.md.
     """
 
     def __init__(self):

@@ -212,6 +212,15 @@ class Net:
         srv, self._server = self._server, None
         return srv.stop()
 
+    def serve_drain(self) -> dict:
+        """Graceful shutdown (docs/SERVING.md "Connection limits &
+        drain"): reject new submissions, flip /healthz to draining,
+        resolve every queued request, then stop. Returns stats()."""
+        if getattr(self, "_server", None) is None:
+            raise RuntimeError("no server running")
+        srv, self._server = self._server, None
+        return srv.drain()
+
     def has_layer(self, layer_name: str) -> bool:
         return layer_name in self._net.net_cfg.layer_name_map
 

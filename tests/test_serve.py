@@ -964,3 +964,482 @@ def test_no_http_thread_unless_armed(trainer):
                     if t.name == "telemetry-http"]
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# canaried rollout + automatic rollback
+# (docs/SERVING.md "Canary runbook")
+# ---------------------------------------------------------------------------
+def _perturbed_trainer():
+    """A realistic swap candidate: the incumbent's weights nudged by
+    0.1% - bitwise-different params whose argmax agrees on nearly
+    every row, the shape two consecutive checkpoints of one training
+    run have. (Two unrelated random inits agree only ~1/3 of the time
+    on 3-class argmax, and the judge rolls them back - correctly.)"""
+    t = make_trainer()
+    w, _ = t.get_weight("fc1", "wmat")
+    t.set_weight(w * 1.001, "fc1", "wmat")
+    return t
+
+
+def test_canary_promotes_healthy_candidate_mid_storm(tmp_path):
+    """swap_to() under a canary config stages the candidate, routes a
+    deterministic traffic fraction at it through the SAME warmed
+    bucket executables (zero recompiles), and auto-promotes after the
+    window: post-promote answers are bitwise the candidate's, nothing
+    drops, the incumbent's last pre-swap answers are unchanged."""
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    tr_new = _perturbed_trainer()
+    ck = str(tmp_path / "cand.model")
+    _save_checkpoint(tr_new, ck)
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=2,
+                 canary_frac=0.5, canary_window=1.0)
+    srv.warmup()
+    n_warm = srv.executable_cache_size()
+    srv.start()
+    rng = np.random.RandomState(21)
+    probe = req(rng, 5)
+    try:
+        old_ref = srv.submit(probe).result(timeout=60)
+        assert srv.swap_to(ck) is True
+        assert srv.stats()["canary_active"] is True
+        futs = []
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            futs.append(srv.submit(req(rng, int(rng.randint(1, 9)))))
+            if srv.stats()["canary_promoted"]:
+                break
+            time.sleep(0.005)
+        for f in futs:
+            f.result(timeout=120)
+        stats = srv.stats()
+        assert stats["canary_promoted"] == 1, "judge never promoted"
+        assert stats["canary_rolled_back"] == 0
+        assert stats["swaps"] == 1
+        assert stats["canary_requests"] > 0, \
+            "no traffic ever routed to the candidate side"
+        assert stats["errors"] == 0
+        assert srv.executable_cache_size() == n_warm, \
+            "canary must not recompile (params are arguments)"
+        new_out = srv.submit(probe).result(timeout=60)
+    finally:
+        srv.stop()
+    # cold reference: a fresh server over the candidate's weights
+    srv2 = Server(tr_new, max_batch=8, max_wait_ms=1.0, replicas=1)
+    srv2.warmup()
+    srv2.start()
+    try:
+        cold_ref = srv2.submit(probe).result(timeout=60)
+    finally:
+        srv2.stop()
+    assert not np.array_equal(old_ref, new_out), \
+        "promote visibly changed the weights"
+    assert np.array_equal(new_out, cold_ref), \
+        "post-promote serving must be bitwise the candidate"
+    reg = telemetry.get().registry
+    assert reg.counter("serve.canary_promoted").value == 1
+    assert reg.counter("serve.canary_requests").value > 0
+
+
+def test_canary_rolls_back_on_divergence(tmp_path):
+    """A candidate whose shadow outputs diverge (canary_divergence
+    fault NaN-poisons them) is rolled back: swaps stays 0, the
+    incumbent keeps serving bitwise-identical answers, and no request
+    errors - rollback is invisible to clients."""
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    tr_new = _perturbed_trainer()
+    ck = str(tmp_path / "cand.model")
+    _save_checkpoint(tr_new, ck)
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=2,
+                 canary_frac=0.25, canary_window=1.0)
+    srv.warmup()
+    srv.start()
+    rng = np.random.RandomState(22)
+    probe = req(rng, 4)
+    try:
+        before = srv.submit(probe).result(timeout=60)
+        fault.clear()
+        for i in range(50):
+            fault.inject("canary_divergence", "corrupt", at=i + 1)
+        assert srv.swap_to(ck) is True
+        futs = []
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            futs.append(srv.submit(req(rng, 3)))
+            if srv.stats()["canary_rolled_back"]:
+                break
+            time.sleep(0.005)
+        for f in futs:
+            f.result(timeout=120)
+        stats = srv.stats()
+        assert stats["canary_rolled_back"] == 1, \
+            "poisoned candidate never rolled back"
+        assert stats["swaps"] == 0
+        assert stats["canary_promoted"] == 0
+        assert stats["errors"] == 0
+        after = srv.submit(probe).result(timeout=60)
+        assert np.array_equal(before, after), \
+            "rollback must leave the incumbent bitwise untouched"
+    finally:
+        fault.clear()
+        srv.stop()
+    assert telemetry.get().registry.counter(
+        "serve.canary_rolled_back").value == 1
+
+
+def test_canary_judge_crash_fails_safe(tmp_path):
+    """A judge that dies (canary_judge_error fault) must never leave
+    the canary half-routed forever: the candidate is rolled back and
+    the incumbent keeps serving unchanged."""
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    tr_new = _perturbed_trainer()
+    ck = str(tmp_path / "cand.model")
+    _save_checkpoint(tr_new, ck)
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 canary_frac=0.5, canary_window=30.0)
+    srv.warmup()
+    srv.start()
+    rng = np.random.RandomState(23)
+    probe = req(rng, 4)
+    try:
+        before = srv.submit(probe).result(timeout=60)
+        fault.clear()
+        fault.inject("canary_judge_error", "crash")
+        assert srv.swap_to(ck) is True
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if srv.stats()["canary_rolled_back"]:
+                break
+            time.sleep(0.02)
+        stats = srv.stats()
+        assert stats["canary_rolled_back"] == 1, \
+            "judge crash never resolved to a rollback"
+        assert stats["swaps"] == 0
+        assert stats["canary_active"] is False
+        after = srv.submit(probe).result(timeout=60)
+        assert np.array_equal(before, after)
+    finally:
+        fault.clear()
+        srv.stop()
+
+
+def test_unarmed_swap_is_direct_no_judge_thread(tmp_path):
+    """Byte-parity guard: without canary_frac, swap_to() flips
+    immediately (PR 16 semantics) and no judge thread exists."""
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    tr_new = _perturbed_trainer()
+    ck = str(tmp_path / "cand.model")
+    _save_checkpoint(tr_new, ck)
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1)
+    srv.warmup()
+    srv.start()
+    try:
+        assert srv.swap_to(ck) is True
+        stats = srv.stats()
+        assert stats["swaps"] == 1
+        assert stats["canary_active"] is False
+        assert stats["canary_requests"] == 0
+        assert not [t for t in threading.enumerate()
+                    if t.name == "serve-canary-judge"]
+    finally:
+        srv.stop()
+
+
+def test_publish_meta_sidecar_roundtrip(tmp_path):
+    """publish_model writes a provenance sidecar BEFORE the model
+    copy; read_publish_meta returns it, and None when absent."""
+    from cxxnet_tpu.nnet import checkpoint
+    tr = make_trainer()
+    src = str(tmp_path / "a.model")
+    _save_checkpoint(tr, src)
+    pub = str(tmp_path / "latest.model")
+    checkpoint.publish_model(src, pub)
+    meta = checkpoint.read_publish_meta(pub)
+    assert meta is not None
+    assert meta["src"] == os.path.abspath(src)
+    assert meta["torn"] is False
+    assert meta["bytes"] == os.path.getsize(src)
+    assert checkpoint.read_publish_meta(
+        str(tmp_path / "missing.model")) is None
+
+
+# ---------------------------------------------------------------------------
+# hardened ingress: Retry-After clamp, slow-loris, body cap, accept
+# gate, graceful drain (docs/SERVING.md "Connection limits & drain")
+# ---------------------------------------------------------------------------
+def _read_until_eof(sock, timeout=10.0):
+    sock.settimeout(timeout)
+    buf = b""
+    try:
+        while True:
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+    except OSError:
+        pass
+    return buf
+
+
+def test_retry_after_cold_clamp_pinned():
+    """A 429 shed before the drain-rate EWMA has a single sample must
+    advise the documented cold-start clamp - never garbage derived
+    from a rate of zero."""
+    from cxxnet_tpu.serve import QueueFullError
+    from cxxnet_tpu.serve.server import RETRY_AFTER_COLD_S
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 queue_limit=8)
+    srv.warmup()
+    _stall_dispatch(64, 0.3)
+    srv.start()
+    rng = np.random.RandomState(24)
+    futs, errs = [], []
+    try:
+        for _ in range(30):
+            try:
+                futs.append(srv.submit(req(rng, 4)))
+            except QueueFullError as e:
+                errs.append(e)
+        assert errs, "queue never filled past the limit"
+        # the first shed lands before any batch completed (0.3 s
+        # stall): no drain-rate sample exists yet
+        assert errs[0].retry_after_s == RETRY_AFTER_COLD_S
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        fault.clear()
+        srv.stop()
+
+
+def test_slow_loris_cut_while_service_continues():
+    """Two live loris sockets - one stalled mid-headers, one stalled
+    mid-body - are cut at serve_conn_timeout_ms while a concurrent
+    well-behaved request completes normally."""
+    import socket
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 http_port=0, conn_timeout_ms=400.0)
+    srv.warmup()
+    srv.start()
+    rng = np.random.RandomState(25)
+    try:
+        port = srv.metrics_server.port
+        s1 = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s1.sendall(b"POST /predict HTTP/1.0\r\nContent-")  # headers stall
+        s2 = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s2.sendall(b"POST /predict HTTP/1.0\r\n"
+                   b"Content-Length: 1000\r\n\r\nxx")  # body stall
+        t0 = time.monotonic()
+        code, _, out = _post_predict(
+            port, {"data": req(rng, 2).reshape(2, -1).tolist()})
+        assert code == 200 and out["rows"] == 2
+        body_resp = _read_until_eof(s2)
+        t_body = time.monotonic() - t0
+        _read_until_eof(s1)
+        t_hdr = time.monotonic() - t0
+        s1.close()
+        s2.close()
+        # both cut near the deadline, far before the 10 s eof budget
+        assert t_body < 8.0 and t_hdr < 8.0
+        # the body-phase victim gets a clean 408 before the cut
+        assert b"408" in body_resp.split(b"\r\n")[0], body_resp[:80]
+        stats = srv.stats()
+        assert stats["conn_timeouts"] >= 2
+        assert stats["errors"] == 0
+    finally:
+        srv.stop()
+    assert telemetry.get().registry.counter(
+        "serve.conn_timeouts").value >= 2
+
+
+def test_oversized_body_413_then_serves_normally():
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 http_port=0, max_body_bytes=512)
+    srv.warmup()
+    srv.start()
+    rng = np.random.RandomState(26)
+    try:
+        port = srv.metrics_server.port
+        code, _, out = _post_predict(
+            port, {"data": req(rng, 16).reshape(16, -1).tolist()})
+        assert code == 413
+        assert out["max_body_bytes"] == 512
+        # a small request on a fresh connection still serves
+        code, _, out = _post_predict(
+            port, {"data": [[0.0] * 36]})
+        assert code == 200 and out["rows"] == 1
+        assert srv.stats()["conn_oversized"] == 1
+    finally:
+        srv.stop()
+
+
+def test_accept_gate_503_with_retry_after_then_recovers():
+    """Past serve_max_conns the accept gate answers a raw 503 with
+    Retry-After WITHOUT spawning a handler thread, flips its own
+    health source, and recovers hysteretically once connections
+    drop - driven by real /healthz polling (each GET is itself a
+    connection exercising the gate)."""
+    import socket
+    import urllib.error
+    import urllib.request
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1,
+                 http_port=0, max_conns=1)
+    srv.shed_clear_ms = 200.0
+    srv.warmup()
+    srv.start()
+    try:
+        port = srv.metrics_server.port
+        hold = socket.create_connection(
+            ("127.0.0.1", port), timeout=10)
+        hold.sendall(b"GET /healthz HTTP/1.0\r\nX-Hold")  # occupy slot
+        time.sleep(0.3)
+        rej = socket.create_connection(
+            ("127.0.0.1", port), timeout=10)
+        rej.sendall(b"GET /healthz HTTP/1.0\r\n\r\n")
+        buf = _read_until_eof(rej)
+        rej.close()
+        assert b"503" in buf.split(b"\r\n")[0], buf[:80]
+        assert b"Retry-After: 1" in buf, buf[:200]
+        ok, reasons = telemetry.get().health.status()
+        assert not ok and "serve_conns" in reasons, reasons
+        hold.close()
+        recovered = False
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5)
+                if r.status == 200:
+                    recovered = True
+                    break
+            except (urllib.error.HTTPError, OSError):
+                pass
+            time.sleep(0.1)
+        assert recovered, "conn gate never recovered"
+        assert srv.stats()["conn_rejected"] >= 1
+    finally:
+        srv.stop()
+    assert telemetry.get().registry.counter(
+        "serve.conn_rejected").value >= 1
+
+
+def test_drain_resolves_every_queued_future():
+    """drain() flips the serve_drain health source, rejects new
+    submits with a typed error, and resolves EVERY already-admitted
+    future before returning - zero drops of accepted work."""
+    from cxxnet_tpu.utils import fault
+    telemetry.reset_for_tests()
+    tr = make_trainer()
+    srv = Server(tr, max_batch=8, max_wait_ms=1.0, replicas=1)
+    srv.warmup()
+    _stall_dispatch(16, 0.2)
+    srv.start()
+    rng = np.random.RandomState(27)
+    futs = [srv.submit(req(rng, 2)) for _ in range(10)]
+    state = {}
+    th = threading.Thread(
+        target=lambda: state.update(stats=srv.drain()))
+    th.start()
+    try:
+        seen = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not seen:
+            ok, reasons = telemetry.get().health.status()
+            seen = "serve_drain" in reasons
+            time.sleep(0.01)
+        assert seen, "drain never flipped the health source"
+        with pytest.raises(RuntimeError):
+            srv.submit(req(rng, 1))
+    finally:
+        th.join(timeout=120)
+        fault.clear()
+    for f in futs:
+        assert f.result(timeout=1).shape == (2, 3)
+    assert state["stats"]["errors"] == 0
+    assert telemetry.get().health.ok, \
+        "serve_drain verdict must clear once drained"
+
+
+def test_cli_serve_sigterm_drains(tmp_path, capsys):
+    """SIGTERM during task=serve stops admission, drains every
+    admitted request to the output file, and exits 0 - the k8s
+    preStop / rolling-restart contract."""
+    import signal
+    from cxxnet_tpu.main import LearnTask
+    from cxxnet_tpu.tools.telemetry_smoke import write_synth_mnist
+    from cxxnet_tpu.utils import fault
+    d = str(tmp_path)
+    write_synth_mnist(d, 96, 0, "train")
+    write_synth_mnist(d, 128, 1, "test")
+    conf = os.path.join(d, "serve_term.conf")
+    with open(conf, "w") as f:
+        f.write(CLI_CONF.format(d=d))
+    mdir = os.path.join(d, "models")
+    assert LearnTask().run([conf, f"model_dir={mdir}"]) == 0
+    model = os.path.join(mdir, "0001.model")
+    # safety net: a no-op handler is what task_serve restores, so a
+    # straggler SIGTERM after the task exits cannot kill pytest
+    old = signal.signal(signal.SIGTERM, lambda s, f: None)
+    killer_stop = threading.Event()
+    # the registry is process-global: measure against a baseline, or
+    # requests counted by EARLIER tests fire the kill before the
+    # drain handler is even installed
+    n0 = telemetry.get().registry.counter("serve.requests").value
+
+    def killer():
+        # fire once real requests are flowing (not during warmup)
+        while not killer_stop.is_set():
+            n = telemetry.get().registry.counter(
+                "serve.requests").value
+            if n - n0 >= 8:
+                os.kill(os.getpid(), signal.SIGTERM)
+                return
+            time.sleep(0.01)
+
+    _stall_dispatch(2000, 0.05)
+    th = threading.Thread(target=killer, daemon=True)
+    th.start()
+    try:
+        rc = LearnTask().run(
+            [conf, "task=serve", f"model_in={model}",
+             f"pred={d}/pred_term.txt", "serve_rows=1",
+             "serve_max_batch=8"])
+    finally:
+        killer_stop.set()
+        th.join(timeout=10)
+        fault.clear()
+        signal.signal(signal.SIGTERM, old)
+    assert rc == 0
+    assert "SIGTERM - draining" in capsys.readouterr().out
+    with open(os.path.join(d, "pred_term.txt")) as f:
+        lines = f.read().splitlines()
+    # partial but nonempty: admission stopped mid-stream, every
+    # admitted row drained
+    assert 0 < len(lines) < 128
+    for ln in lines:
+        float(ln)
+
+
+def test_canary_ingress_keys_registered_in_schema():
+    from cxxnet_tpu.analysis import schema
+    reg = schema.get_registry()
+    for key in ("swap_canary_frac", "swap_canary_window",
+                "serve_conn_timeout_ms", "serve_max_conns",
+                "serve_max_body_bytes"):
+        assert reg.recognizes(key), key
+    assert schema.suggest("swap_canary_fracc") == "swap_canary_frac"
+    assert schema.suggest("serve_max_connss") == "serve_max_conns"
